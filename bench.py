@@ -1,0 +1,96 @@
+"""Benchmark: boosting iterations/sec on a HIGGS-shaped synthetic dataset.
+
+Baseline (BASELINE.md): reference CPU trains HIGGS (10.5M rows x 28 features,
+num_leaves=255, 500 iters) in 238.5 s on 2x E5-2670v3 => 2.096 iters/sec.
+GPU parity experiments use max_bin=63 (docs/GPU-Performance.rst:43-45), which we
+adopt for the TPU histogram kernels.
+
+Prints ONE JSON line: {"metric", "value", "unit", "vs_baseline"}.
+
+Env overrides: LGBM_TPU_BENCH_ROWS, LGBM_TPU_BENCH_ITERS, LGBM_TPU_BENCH_LEAVES.
+"""
+import json
+import os
+import sys
+import time
+
+import numpy as np
+
+BASELINE_ITERS_PER_SEC = 500.0 / 238.5
+
+
+def synth_higgs(n_rows: int, n_feat: int = 28, seed: int = 0):
+    """HIGGS-shaped binary problem: mixture of informative kinematic-ish features."""
+    rng = np.random.RandomState(seed)
+    X = rng.randn(n_rows, n_feat).astype(np.float32)
+    # a few nonlinear informative combinations, rest noise (signal vs background)
+    w = rng.randn(8)
+    logits = (X[:, :8] @ w) * 0.7 + 0.5 * np.abs(X[:, 8]) * X[:, 9] \
+        - 0.4 * (X[:, 10] ** 2) + 0.3
+    p = 1.0 / (1.0 + np.exp(-logits))
+    y = (rng.rand(n_rows) < p).astype(np.float32)
+    return X, y
+
+
+def main():
+    n_rows = int(os.environ.get("LGBM_TPU_BENCH_ROWS", 1_000_000))
+    n_iters = int(os.environ.get("LGBM_TPU_BENCH_ITERS", 20))
+    num_leaves = int(os.environ.get("LGBM_TPU_BENCH_LEAVES", 255))
+    max_bin = int(os.environ.get("LGBM_TPU_BENCH_BINS", 63))
+
+    import jax
+    import lightgbm_tpu as lgb
+
+    t0 = time.time()
+    X, y = synth_higgs(n_rows)
+    t_gen = time.time() - t0
+
+    params = {
+        "objective": "binary",
+        "num_leaves": num_leaves,
+        "max_bin": max_bin,
+        "learning_rate": 0.1,
+        "min_data_in_leaf": 20,
+        "verbosity": -1,
+        "metric": "auc",
+    }
+    t0 = time.time()
+    ds = lgb.Dataset(X, label=y)
+    ds.construct()
+    t_bin = time.time() - t0
+
+    booster = lgb.Booster(params=params, train_set=ds)
+    # warmup: compile + first iteration
+    t0 = time.time()
+    booster.update()
+    jax.block_until_ready(booster.raw_train_score())
+    t_compile = time.time() - t0
+
+    t0 = time.time()
+    for _ in range(n_iters):
+        booster.update()
+    jax.block_until_ready(booster.raw_train_score())
+    dt = time.time() - t0
+    iters_per_sec = n_iters / dt
+
+    # sanity: model must actually learn
+    from lightgbm_tpu.metrics import _auc
+    import jax.numpy as jnp
+    prob = 1.0 / (1.0 + np.exp(-np.asarray(booster.raw_train_score())))
+    auc = float(_auc(jnp.asarray(y), jnp.asarray(prob), None))
+
+    result = {
+        "metric": "boosting_iters_per_sec_higgs1m_l255_b63",
+        "value": round(iters_per_sec, 4),
+        "unit": "iters/sec",
+        "vs_baseline": round(iters_per_sec / BASELINE_ITERS_PER_SEC, 4),
+    }
+    print(json.dumps(result))
+    print(f"# rows={n_rows} iters={n_iters} leaves={num_leaves} bins={max_bin} "
+          f"gen={t_gen:.1f}s bin={t_bin:.1f}s compile+first={t_compile:.1f}s "
+          f"train={dt:.1f}s train_auc={auc:.4f} backend={jax.default_backend()}",
+          file=sys.stderr)
+
+
+if __name__ == "__main__":
+    main()

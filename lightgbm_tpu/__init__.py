@@ -1,0 +1,39 @@
+"""lightgbm_tpu — a TPU-native gradient-boosted decision tree framework.
+
+A from-scratch re-design of the LightGBM feature set (reference: kuoorczp/LightGBM
+v2.3.2) for TPU hardware: histogram construction / split search / tree growth run as
+jitted XLA (and Pallas) programs over a device-resident uint8 binned matrix;
+distributed training uses ``jax.sharding`` meshes with XLA collectives in place of
+the reference's socket/MPI network layer.
+
+Public API mirrors the reference python package (python-package/lightgbm/__init__.py):
+Dataset, Booster, train, cv, the sklearn wrappers, callbacks, and plotting.
+"""
+
+from .basic import Booster, Dataset
+from .callback import (EarlyStopException, early_stopping, log_evaluation,
+                       print_evaluation, record_evaluation, reset_parameter)
+from .config import Config
+from .engine import cv, train
+from .utils import log
+from .utils.log import LightGBMError
+
+try:
+    from .sklearn import LGBMClassifier, LGBMModel, LGBMRanker, LGBMRegressor
+    _SKLEARN_OK = True
+except ImportError:  # pragma: no cover
+    _SKLEARN_OK = False
+
+try:
+    from .plotting import (plot_importance, plot_metric, plot_split_value_histogram,
+                           plot_tree, create_tree_digraph)
+except ImportError:  # pragma: no cover
+    pass
+
+__version__ = "0.1.0"
+
+__all__ = ["Dataset", "Booster", "Config", "train", "cv",
+           "LightGBMError",
+           "early_stopping", "print_evaluation", "log_evaluation",
+           "record_evaluation", "reset_parameter", "EarlyStopException",
+           "LGBMModel", "LGBMClassifier", "LGBMRegressor", "LGBMRanker"]

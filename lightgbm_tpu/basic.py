@@ -1,0 +1,529 @@
+"""User-facing Dataset and Booster.
+
+Mirrors the reference python package's core objects (python-package/lightgbm/
+basic.py:712 Dataset, :1666 Booster) — but there is no ctypes/C-API hop: the Python
+layer talks directly to the JAX device runtime. Binning happens lazily at
+``construct()`` time like the reference's lazy Dataset, and validation sets are
+aligned to the training set's bin mappers (reference: Dataset::CreateValid,
+dataset.cpp:742).
+"""
+from __future__ import annotations
+
+import json
+from typing import Any, Dict, List, Optional, Sequence, Union
+
+import numpy as np
+
+try:
+    import pandas as pd
+    _PANDAS = True
+except Exception:  # pragma: no cover
+    _PANDAS = False
+
+import jax
+import jax.numpy as jnp
+
+from .binning import BinMapper, BinnedDataset, bin_data, find_bin_mappers
+from .config import Config, params_to_config
+from .metrics import create_metrics, default_metric_for_objective
+from .models.gbdt import GBDT
+from .models.tree import Tree, stack_trees
+from .objectives import create_objective
+from .ops import predict as P
+from .utils import log
+from .io import model_text
+
+
+def _to_numpy_2d(data) -> np.ndarray:
+    if _PANDAS and isinstance(data, pd.DataFrame):
+        return data.to_numpy(dtype=np.float64, na_value=np.nan)
+    arr = np.asarray(data, dtype=np.float64)
+    if arr.ndim == 1:
+        arr = arr.reshape(-1, 1)
+    return arr
+
+
+def _to_numpy_1d(data) -> Optional[np.ndarray]:
+    if data is None:
+        return None
+    if _PANDAS and isinstance(data, (pd.Series,)):
+        data = data.to_numpy()
+    return np.asarray(data, dtype=np.float64).reshape(-1)
+
+
+class Dataset:
+    """Training dataset (reference: lightgbm.Dataset, basic.py:712).
+
+    Lazily constructed: raw data is kept host-side until ``construct()`` bins it and
+    ships the uint8 bin matrix to device HBM.
+    """
+
+    def __init__(self, data, label=None, reference: Optional["Dataset"] = None,
+                 weight=None, group=None, init_score=None,
+                 feature_name: Union[str, List[str]] = "auto",
+                 categorical_feature: Union[str, List] = "auto",
+                 params: Optional[Dict[str, Any]] = None,
+                 free_raw_data: bool = True):
+        self.params = dict(params or {})
+        self.raw_data = data
+        self.label = _to_numpy_1d(label)
+        self.weight = _to_numpy_1d(weight)
+        self.group = None if group is None else np.asarray(group, dtype=np.int64)
+        self.init_score = _to_numpy_1d(init_score)
+        self.reference = reference
+        self.feature_name = feature_name
+        self.categorical_feature = categorical_feature
+        self.free_raw_data = free_raw_data
+        self._constructed = False
+        # filled by construct():
+        self.mappers: List[BinMapper] = []
+        self.feature_map: Optional[np.ndarray] = None
+        self.bins = None            # jnp uint8 [N, F_used]
+        self.num_bins_dev = None    # jnp i32 [F_used]
+        self.na_bin_dev = None      # jnp i32 [F_used]
+        self.missing_type_dev = None
+        self._names: List[str] = []
+        self._num_data = None
+        self._num_features_raw = None
+        if data is not None:
+            arr_shape = (data.shape if hasattr(data, "shape")
+                         else np.asarray(data).shape)
+            self._num_data = arr_shape[0]
+            self._num_features_raw = arr_shape[1] if len(arr_shape) > 1 else 1
+
+    # ---- construction ----
+    def _resolve_categorical(self, ncols: int, columns) -> List[int]:
+        cf = self.categorical_feature
+        if cf == "auto" or cf is None:
+            if _PANDAS and isinstance(self.raw_data, pd.DataFrame):
+                return [i for i, dt in enumerate(self.raw_data.dtypes)
+                        if isinstance(dt, pd.CategoricalDtype)]
+            return []
+        out = []
+        for c in (cf if isinstance(cf, (list, tuple)) else [cf]):
+            if isinstance(c, int):
+                out.append(c)
+            elif isinstance(c, str) and columns is not None and c in columns:
+                out.append(list(columns).index(c))
+        return sorted(set(out))
+
+    def construct(self) -> "Dataset":
+        if self._constructed:
+            return self
+        conf = params_to_config(self.params)
+        if self.reference is not None:
+            ref = self.reference.construct()
+            raw = _to_numpy_2d(self.raw_data)
+            self.mappers = ref.mappers
+            self.feature_map = ref.feature_map
+            self._names = ref._names
+            used = raw[:, ref.feature_map] if ref.feature_map is not None else raw
+            bins = np.zeros(used.shape, dtype=np.uint8)
+            for k in range(used.shape[1]):
+                bins[:, k] = ref.mappers[k].values_to_bins(used[:, k]).astype(np.uint8)
+            self._finish_device(bins, ref.num_bins_dev, ref.na_bin_dev,
+                                ref.missing_type_dev, ref.max_num_bins)
+            return self
+
+        raw = _to_numpy_2d(self.raw_data)
+        columns = (list(self.raw_data.columns)
+                   if _PANDAS and isinstance(self.raw_data, pd.DataFrame) else None)
+        cats = self._resolve_categorical(raw.shape[1], columns)
+        if _PANDAS and isinstance(self.raw_data, pd.DataFrame):
+            # encode pandas categoricals as their code (reference: basic.py:313-400)
+            raw = raw.copy()
+        mappers = find_bin_mappers(
+            raw, max_bin=conf.max_bin, min_data_in_bin=conf.min_data_in_bin,
+            sample_cnt=conf.bin_construct_sample_cnt, categorical=cats,
+            use_missing=conf.use_missing, zero_as_missing=conf.zero_as_missing,
+            seed=conf.data_random_seed)
+        binned = bin_data(raw, mappers)
+        self.mappers = binned.mappers
+        self.feature_map = binned.feature_map
+        if self.feature_name != "auto" and isinstance(self.feature_name, (list, tuple)):
+            self._names = list(self.feature_name)
+        elif columns is not None:
+            self._names = [str(c) for c in columns]
+        else:
+            self._names = [f"Column_{i}" for i in range(raw.shape[1])]
+        num_bins = np.array([m.num_bins for m in self.mappers], dtype=np.int32)
+        na_bin = np.array([m.na_bin for m in self.mappers], dtype=np.int32)
+        mtypes = np.array([m.missing_type for m in self.mappers], dtype=np.int32)
+        maxb = int(num_bins.max()) if len(num_bins) else 1
+        self._finish_device(binned.bins, jnp.asarray(num_bins), jnp.asarray(na_bin),
+                            jnp.asarray(mtypes), maxb)
+        return self
+
+    def _finish_device(self, bins_np, num_bins_dev, na_bin_dev, mtypes_dev, maxb):
+        self.bins = jnp.asarray(bins_np)
+        self.num_bins_dev = num_bins_dev
+        # na_bin == -1 means none; remap to an out-of-range bin so device compares fail
+        na = np.asarray(na_bin_dev)
+        self.na_bin_dev = jnp.asarray(np.where(na < 0, 255 + 1, na).astype(np.int32))
+        self._na_bin_raw = na
+        self.missing_type_dev = mtypes_dev
+        self.max_num_bins = int(maxb)
+        self._num_data = bins_np.shape[0]
+        if self.label is not None:
+            self.label = jnp.asarray(self.label, dtype=jnp.float32)
+        if self.weight is not None:
+            self.weight = jnp.asarray(self.weight, dtype=jnp.float32)
+        self._constructed = True
+        if self.free_raw_data:
+            self.raw_data = None
+
+    def create_valid(self, data, label=None, weight=None, group=None,
+                     init_score=None, params=None) -> "Dataset":
+        return Dataset(data, label=label, reference=self, weight=weight,
+                       group=group, init_score=init_score, params=params)
+
+    # ---- accessors (reference Dataset API surface) ----
+    @property
+    def num_data(self) -> int:
+        return self._num_data
+
+    @property
+    def num_features(self) -> int:
+        if self._constructed:
+            return self.bins.shape[1]
+        return self._num_features_raw
+
+    def num_feature(self) -> int:
+        return self._num_features_raw or self.num_features
+
+    def get_label(self):
+        return None if self.label is None else np.asarray(self.label)
+
+    def get_weight(self):
+        return None if self.weight is None else np.asarray(self.weight)
+
+    def get_group(self):
+        return self.group
+
+    def get_init_score(self):
+        return self.init_score
+
+    def set_label(self, label):
+        self.label = (jnp.asarray(_to_numpy_1d(label), dtype=jnp.float32)
+                      if self._constructed else _to_numpy_1d(label))
+
+    def set_weight(self, weight):
+        self.weight = (jnp.asarray(_to_numpy_1d(weight), dtype=jnp.float32)
+                       if self._constructed and weight is not None
+                       else _to_numpy_1d(weight))
+
+    def set_group(self, group):
+        self.group = None if group is None else np.asarray(group, dtype=np.int64)
+
+    def set_init_score(self, init_score):
+        self.init_score = _to_numpy_1d(init_score)
+
+    def feature_names(self) -> List[str]:
+        return list(self._names)
+
+
+class Booster:
+    """Trained/training model handle (reference: lightgbm.Booster, basic.py:1666)."""
+
+    def __init__(self, params: Optional[Dict] = None,
+                 train_set: Optional[Dataset] = None,
+                 model_file: Optional[str] = None,
+                 model_str: Optional[str] = None):
+        self.params = dict(params or {})
+        self.config = params_to_config(self.params)
+        self._gbdt: Optional[GBDT] = None
+        self.trees: List[Tree] = []
+        self._loaded_meta: Dict[str, Any] = {}
+        self.best_iteration = -1
+        self.best_score: Dict[str, Dict[str, float]] = {}
+        self.train_set = None
+        self.name_valid_sets: List[str] = []
+
+        if model_file is not None:
+            with open(model_file) as f:
+                self._load_model_string(f.read())
+            return
+        if model_str is not None:
+            self._load_model_string(model_str)
+            return
+        if train_set is not None:
+            self._setup_train(train_set)
+
+    # ---- training wiring ----
+    def _setup_train(self, train_set: Dataset) -> None:
+        train_set.params = {**self.params, **train_set.params} if train_set.params else dict(self.params)
+        train_set.construct()
+        self.train_set = train_set
+        conf = self.config
+        objective = create_objective(conf.objective, conf)
+        metric_names = conf.metric or [default_metric_for_objective(conf.objective)]
+        metrics = create_metrics(metric_names, conf, conf.objective)
+        boosting = conf.boosting.lower()
+        if boosting in ("gbdt", "gbrt"):
+            cls = GBDT
+        elif boosting == "dart":
+            from .models.dart import DART
+            cls = DART
+        elif boosting == "goss":
+            from .models.goss import GOSS
+            cls = GOSS
+        elif boosting in ("rf", "random_forest"):
+            from .models.rf import RF
+            cls = RF
+        else:
+            log.fatal(f"unknown boosting type {conf.boosting}")
+        self._gbdt = cls(conf, train_set, objective, metrics)
+        self._objective = objective
+
+    def add_valid(self, data: Dataset, name: str) -> None:
+        data.construct()
+        self._gbdt.add_valid(data, name)
+        self.name_valid_sets.append(name)
+
+    def update(self, train_set=None, fobj=None) -> bool:
+        """One boosting iteration (reference: Booster.update, basic.py:2048)."""
+        if fobj is not None:
+            score = self.raw_train_score()
+            grad, hess = fobj(score, self._gbdt.train_set)
+            grad = jnp.asarray(np.asarray(grad, dtype=np.float32))
+            hess = jnp.asarray(np.asarray(hess, dtype=np.float32))
+            k = self._gbdt.num_tree_per_iteration
+            if k > 1:
+                grad = grad.reshape(-1, k) if grad.ndim == 1 else grad
+                hess = hess.reshape(-1, k) if hess.ndim == 1 else hess
+            return self._gbdt.train_one_iter(grad, hess)
+        return self._gbdt.train_one_iter()
+
+    def rollback_one_iter(self):
+        self._gbdt.rollback_one_iter()
+        return self
+
+    @property
+    def current_iteration(self) -> int:
+        return self._gbdt.iter_ if self._gbdt else len(self.trees) // max(self.num_model_per_iteration, 1)
+
+    def num_model_per_iteration(self) -> int:
+        if self._gbdt:
+            return self._gbdt.num_tree_per_iteration
+        return int(self._loaded_meta.get("num_tree_per_iteration", 1))
+
+    def num_trees(self) -> int:
+        return self._gbdt.num_trees() if self._gbdt else len(self.trees)
+
+    def raw_train_score(self):
+        return self._gbdt.train_score
+
+    def eval_train(self):
+        return self._gbdt.eval_train()
+
+    def eval_valid(self):
+        return self._gbdt.eval_valid()
+
+    # ---- prediction ----
+    def _ensure_host_trees(self) -> List[Tree]:
+        if self._gbdt is not None:
+            self.trees = self._gbdt.finalize()
+        return self.trees
+
+    def predict(self, data, num_iteration: Optional[int] = None,
+                raw_score: bool = False, pred_leaf: bool = False,
+                pred_contrib: bool = False, **kwargs) -> np.ndarray:
+        """Batch prediction on raw features (reference: Booster.predict ->
+        Predictor, predictor.hpp:29)."""
+        trees = self._ensure_host_trees()
+        k = (self._gbdt.num_tree_per_iteration if self._gbdt
+             else int(self._loaded_meta.get("num_tree_per_iteration", 1)))
+        if num_iteration is None:
+            num_iteration = self.best_iteration if self.best_iteration > 0 else -1
+        if num_iteration and num_iteration > 0:
+            trees = trees[: num_iteration * k]
+        x = _to_numpy_2d(data)
+        n = x.shape[0]
+        expected = self.num_feature()
+        if expected and x.shape[1] != expected:
+            log.fatal(f"The number of features in data ({x.shape[1]}) is not the "
+                      f"same as it was in training data ({expected})")
+        if not trees:
+            base = np.zeros((n, k) if k > 1 else n)
+            return base
+        # categorical splits compare count-ordered bins, not raw values: route
+        # through bin space for exact train/predict consistency
+        if (self.train_set is not None and not pred_leaf and not pred_contrib
+                and any(m.bin_type == 1 for m in self.train_set.mappers)):
+            raw = self._predict_binned(x, trees, k)
+            if raw_score:
+                return raw
+            obj = self._objective_for_predict()
+            return np.asarray(obj.convert_output(jnp.asarray(raw))) if obj else raw
+        if pred_leaf:
+            stack = stack_trees(trees, x.shape[1], 256)
+            mt = self._per_feature_missing(x.shape[1], trees)
+            xd = jnp.asarray(x, dtype=jnp.float32)
+            stack_dev = {kk: jnp.asarray(v) for kk, v in stack.items()}
+            max_steps = max(int(stack["num_leaves"].max()) - 1, 1)
+            out = P.predict_leaf_ensemble(stack_dev, xd, jnp.asarray(mt), max_steps)
+            return np.asarray(out)
+        if pred_contrib:
+            return self._predict_contrib(x, trees, k)
+        stack = stack_trees(trees, x.shape[1], 256)
+        mt = self._per_feature_missing(x.shape[1], trees)
+        xd = jnp.asarray(x, dtype=jnp.float32)
+        max_steps = max(int(stack["num_leaves"].max()) - 1, 1)
+        if k == 1:
+            stack_dev = {kk: jnp.asarray(v) for kk, v in stack.items()}
+            raw = np.asarray(P.predict_raw_ensemble(stack_dev, xd, jnp.asarray(mt),
+                                                    max_steps), dtype=np.float64)
+            if self._avg_output():
+                raw = raw / (len(trees))
+        else:
+            raw = np.zeros((n, k))
+            for cls in range(k):
+                cls_trees = trees[cls::k] if False else [trees[i] for i in range(cls, len(trees), k)]
+                stack_c = stack_trees(cls_trees, x.shape[1], 256)
+                stack_dev = {kk: jnp.asarray(v) for kk, v in stack_c.items()}
+                ms = max(int(stack_c["num_leaves"].max()) - 1, 1)
+                raw[:, cls] = np.asarray(
+                    P.predict_raw_ensemble(stack_dev, xd, jnp.asarray(mt), ms))
+            if self._avg_output():
+                raw = raw / (len(trees) // k)
+        if raw_score:
+            return raw
+        obj = self._objective_for_predict()
+        if obj is not None:
+            return np.asarray(obj.convert_output(jnp.asarray(raw)))
+        return raw
+
+    def _predict_binned(self, x: np.ndarray, trees, k: int) -> np.ndarray:
+        """Predict by binning the input with the training mappers and routing in
+        bin space — exactly the training-time semantics (needed for categorical
+        features, whose bins are count-ordered)."""
+        ts = self.train_set
+        used = ts.feature_map
+        bins = np.zeros((x.shape[0], len(ts.mappers)), dtype=np.uint8)
+        for j, m in enumerate(ts.mappers):
+            bins[:, j] = m.values_to_bins(x[:, int(used[j])]).astype(np.uint8)
+        inv = {int(o): j for j, o in enumerate(used)}
+        stack = stack_trees(trees, len(ts.mappers), ts.max_num_bins)
+        # remap node features from original to used-column space
+        for ti, t in enumerate(trees):
+            for ni in range(t.num_leaves - 1):
+                stack["split_feature"][ti, ni] = inv.get(int(t.split_feature[ni]), 0)
+        stack_dev = {kk: jnp.asarray(v) for kk, v in stack.items()}
+        bins_dev = jnp.asarray(bins)
+        max_steps = max(int(stack["num_leaves"].max()) - 1, 1)
+        if k == 1:
+            raw = P.predict_bins_ensemble(stack_dev, bins_dev, ts.na_bin_dev, max_steps)
+            raw = np.asarray(raw, dtype=np.float64)
+            if self._avg_output():
+                raw = raw / len(trees)
+            return raw
+        out = np.zeros((x.shape[0], k))
+        for cls in range(k):
+            sub = {kk: v[cls::k] for kk, v in stack_dev.items()}
+            out[:, cls] = np.asarray(P.predict_bins_ensemble(
+                sub, bins_dev, ts.na_bin_dev, max_steps))
+        if self._avg_output():
+            out = out / (len(trees) // k)
+        return out
+
+    def _avg_output(self) -> bool:
+        if self._gbdt is not None:
+            return self._gbdt.average_output
+        return bool(self._loaded_meta.get("average_output", False))
+
+    def _per_feature_missing(self, nf: int, trees: List[Tree]) -> np.ndarray:
+        mt = np.zeros(nf, dtype=np.int32)
+        for t in trees:
+            for i in range(t.num_leaves - 1):
+                f = t.split_feature[i]
+                if f < nf:
+                    mt[f] = max(mt[f], t.missing_type[i])
+        return mt
+
+    def _predict_contrib(self, x, trees, k):
+        """SHAP-style contributions via per-tree path attribution (reference:
+        PredictContrib, boosting.h:167). Exact TreeSHAP, host-side."""
+        from .io.shap import tree_shap_ensemble
+        return tree_shap_ensemble(x, trees, k, self._base_score(k))
+
+    def _base_score(self, k):
+        return np.zeros(k)
+
+    def _objective_for_predict(self):
+        if self._gbdt is not None:
+            return self._objective
+        name = self._loaded_meta.get("objective", "")
+        if not name:
+            return None
+        conf = self.config.copy()
+        parts = name.split(" ")
+        for p in parts[1:]:
+            if ":" in p:
+                kk, vv = p.split(":", 1)
+                conf.update({kk: vv})
+        try:
+            obj = create_objective(parts[0], conf)
+        except Exception:
+            return None
+        return obj
+
+    # ---- persistence (reference: gbdt_model_text.cpp) ----
+    def save_model(self, filename: str, num_iteration: Optional[int] = None,
+                   start_iteration: int = 0) -> "Booster":
+        with open(filename, "w") as f:
+            f.write(self.model_to_string(num_iteration, start_iteration))
+        return self
+
+    def model_to_string(self, num_iteration: Optional[int] = None,
+                        start_iteration: int = 0) -> str:
+        trees = self._ensure_host_trees()
+        if num_iteration is None:
+            num_iteration = self.best_iteration if self.best_iteration > 0 else -1
+        return model_text.dump_model_text(self, trees, num_iteration, start_iteration)
+
+    def dump_model(self, num_iteration: Optional[int] = None,
+                   start_iteration: int = 0) -> Dict:
+        trees = self._ensure_host_trees()
+        k = self.num_model_per_iteration()
+        if num_iteration is None:
+            num_iteration = self.best_iteration if self.best_iteration > 0 else -1
+        if num_iteration and num_iteration > 0:
+            trees = trees[: num_iteration * k]
+        return model_text.dump_model_json(self, trees)
+
+    def _load_model_string(self, s: str) -> None:
+        meta, trees = model_text.parse_model_text(s)
+        self._loaded_meta = meta
+        self.trees = trees
+        self.best_iteration = -1
+
+    # ---- introspection ----
+    def feature_name(self) -> List[str]:
+        if self.train_set is not None:
+            return self.train_set.feature_names()
+        return list(self._loaded_meta.get("feature_names", []))
+
+    def feature_importance(self, importance_type: str = "split",
+                           iteration: Optional[int] = None) -> np.ndarray:
+        """split/gain importances (reference: boosting.h:229 FeatureImportance)."""
+        trees = self._ensure_host_trees()
+        nf = (self.train_set.num_feature() if self.train_set is not None
+              else int(self._loaded_meta.get("max_feature_idx", -1)) + 1)
+        out = np.zeros(nf)
+        for t in trees:
+            for i in range(t.num_leaves - 1):
+                f = int(t.split_feature[i])
+                if f >= nf:
+                    continue
+                if importance_type == "split":
+                    out[f] += 1
+                else:
+                    out[f] += t.split_gain[i]
+        if importance_type == "split":
+            return out.astype(np.int64 if importance_type == "split" else np.float64)
+        return out
+
+    def num_feature(self) -> int:
+        if self.train_set is not None:
+            return self.train_set.num_feature()
+        return int(self._loaded_meta.get("max_feature_idx", -1)) + 1

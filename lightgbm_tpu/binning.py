@@ -1,0 +1,365 @@
+"""Feature discretization (binning).
+
+TPU-native re-design of the reference's BinMapper (include/LightGBM/bin.h:58,
+src/io/bin.cpp FindBin): per-feature value->bin mapping computed host-side with numpy
+from a row sample, producing a dense ``[num_rows, num_features]`` uint8 binned matrix
+that lives in HBM. Numerical features get (approximately) equal-frequency bins;
+categorical features get count-ordered category bins. Missing handling follows the
+reference's three modes (bin.h:26): None / Zero / NaN.
+
+Unlike the reference there is no sparse/dense column zoo (dense_bin.hpp /
+sparse_bin.hpp / dense_nbits_bin.hpp): on TPU everything is a dense uint8 device
+array, and sparsity is recovered via EFB bundling at ingest (see efb.py).
+"""
+from __future__ import annotations
+
+from dataclasses import dataclass, field
+from typing import Dict, List, Optional, Sequence, Tuple
+
+import numpy as np
+
+from .utils import log
+
+# Values with |v| < kZeroThreshold are "zero" (reference: bin.h kZeroThreshold = 1e-35)
+K_ZERO_THRESHOLD = 1e-35
+
+MISSING_NONE = 0
+MISSING_ZERO = 1
+MISSING_NAN = 2
+
+BIN_NUMERICAL = 0
+BIN_CATEGORICAL = 1
+
+
+@dataclass
+class BinMapper:
+    """Per-feature value->bin mapping (reference: BinMapper, bin.h:58)."""
+
+    num_bins: int = 1
+    bin_type: int = BIN_NUMERICAL
+    missing_type: int = MISSING_NONE
+    # numerical: upper bound of each bin, length == num_bins (last may be +inf);
+    # if missing_type == NaN, the last bin is the NaN bin and its bound is NaN.
+    upper_bounds: np.ndarray = field(default_factory=lambda: np.array([np.inf]))
+    # categorical: bin i holds category cat_values[i]
+    cat_values: np.ndarray = field(default_factory=lambda: np.array([], dtype=np.int64))
+    default_bin: int = 0        # bin of value 0.0 (reference: GetDefaultBin)
+    most_freq_bin: int = 0
+    is_trivial: bool = False    # single bin -> feature carries no information
+    sparse_rate: float = 0.0
+    min_value: float = 0.0
+    max_value: float = 0.0
+
+    @property
+    def na_bin(self) -> int:
+        """Index of the bin holding missing values, or -1 if none."""
+        if self.bin_type == BIN_CATEGORICAL:
+            # bin 0 is the other/missing bin in the categorical mapping
+            return 0 if self.missing_type != MISSING_NONE else -1
+        if self.missing_type == MISSING_NAN:
+            return self.num_bins - 1
+        if self.missing_type == MISSING_ZERO:
+            return self.default_bin
+        return -1
+
+    # ---- construction ----
+    @staticmethod
+    def from_sample(
+        values: np.ndarray,
+        total_cnt: int,
+        max_bin: int,
+        min_data_in_bin: int = 3,
+        min_split_data: int = 0,
+        pre_filter: bool = False,
+        bin_type: int = BIN_NUMERICAL,
+        use_missing: bool = True,
+        zero_as_missing: bool = False,
+        forced_bounds: Optional[Sequence[float]] = None,
+    ) -> "BinMapper":
+        """Find bins from sampled values of one feature.
+
+        ``values`` are the sampled raw values (may contain NaN). ``total_cnt`` is the
+        number of sampled rows; if ``len(values) < total_cnt`` the remainder are
+        implicit zeros (the reference samples only non-zero values,
+        dataset_loader.cpp:867+).
+        """
+        values = np.asarray(values, dtype=np.float64)
+        if bin_type == BIN_CATEGORICAL:
+            return BinMapper._categorical_from_sample(
+                values, total_cnt, max_bin, min_data_in_bin, use_missing)
+
+        na_cnt = int(np.isnan(values).sum())
+        vals = values[~np.isnan(values)]
+        implicit_zeros = max(0, total_cnt - len(values))
+        zero_cnt = implicit_zeros + int((np.abs(vals) < K_ZERO_THRESHOLD).sum())
+        nonzero = vals[np.abs(vals) >= K_ZERO_THRESHOLD]
+
+        if zero_as_missing:
+            missing_type = MISSING_ZERO
+        elif use_missing and na_cnt > 0:
+            missing_type = MISSING_NAN
+        else:
+            missing_type = MISSING_NONE
+            # NaN treated as zero when missing disabled (reference BinMapper::FindBin)
+            zero_cnt += na_cnt
+            na_cnt = 0
+
+        n_avail = max_bin - (1 if missing_type == MISSING_NAN else 0)
+        bounds = BinMapper._find_numerical_bounds(
+            nonzero, zero_cnt, n_avail, min_data_in_bin, forced_bounds=forced_bounds)
+        num_bins = len(bounds)
+        if missing_type == MISSING_NAN:
+            bounds = np.append(bounds, np.nan)
+            num_bins += 1
+
+        m = BinMapper(
+            num_bins=num_bins,
+            bin_type=BIN_NUMERICAL,
+            missing_type=missing_type,
+            upper_bounds=bounds,
+        )
+        m.default_bin = m._value_to_bin_scalar(0.0)
+        m.is_trivial = (num_bins <= 1)
+        m.sparse_rate = zero_cnt / max(1, total_cnt)
+        m.most_freq_bin = m.default_bin if m.sparse_rate >= 0.5 else 0
+        if len(nonzero) or zero_cnt:
+            allv = nonzero if zero_cnt == 0 else np.append(nonzero, 0.0)
+            m.min_value = float(allv.min())
+            m.max_value = float(allv.max())
+        return m
+
+    @staticmethod
+    def _find_numerical_bounds(
+        nonzero: np.ndarray,
+        zero_cnt: int,
+        max_bin: int,
+        min_data_in_bin: int,
+        forced_bounds: Optional[Sequence[float]] = None,
+    ) -> np.ndarray:
+        """Equal-frequency bin upper bounds over (nonzero values + implicit zeros).
+
+        Guarantees: bounds strictly increasing; one bound pair straddles zero when
+        zeros exist (so zero gets its own bin and ``zero_as_missing`` semantics are
+        representable); final bound is +inf.
+        """
+        if len(nonzero) == 0 and zero_cnt == 0:
+            return np.array([np.inf])
+        if forced_bounds is not None and len(forced_bounds):
+            # user-forced boundaries (reference: forcedbins_filename,
+            # dataset_loader + bin.cpp forced bin path): use them verbatim, capped
+            # at max_bin-1 boundaries, final bound +inf
+            fb = np.unique(np.asarray(sorted(forced_bounds), dtype=np.float64))
+            fb = fb[: max(1, max_bin - 1)]
+            return np.append(fb, np.inf)
+        distinct, counts = np.unique(nonzero, return_counts=True)
+        if zero_cnt > 0:
+            pos = np.searchsorted(distinct, 0.0)
+            distinct = np.insert(distinct, pos, 0.0)
+            counts = np.insert(counts, pos, zero_cnt)
+        if len(distinct) <= max(1, max_bin):
+            # every distinct value gets a bin; bounds midway between neighbors
+            if len(distinct) == 1:
+                return np.array([np.inf])
+            mids = (distinct[:-1] + distinct[1:]) / 2.0
+            # keep zero isolated from neighbors
+            bounds = np.append(mids, np.inf)
+            return BinMapper._fix_zero_boundary(bounds, distinct)
+        # equal-frequency greedy: walk distinct values accumulating counts until the
+        # per-bin budget is met (reference: GreedyFindBin in src/io/bin.cpp — ours is a
+        # fresh weighted-quantile formulation, not a translation)
+        total = counts.sum()
+        n_bins = max(1, min(max_bin, int(total // max(1, min_data_in_bin)) or 1))
+        target = total / n_bins
+        bounds_list: List[float] = []
+        acc = 0.0
+        for i in range(len(distinct) - 1):
+            acc += counts[i]
+            if acc >= target - 1e-9 and len(bounds_list) < n_bins - 1:
+                bounds_list.append((distinct[i] + distinct[i + 1]) / 2.0)
+                acc = 0.0
+        bounds = np.array(bounds_list + [np.inf])
+        bounds = np.unique(bounds)
+        if zero_cnt > 0:
+            bounds = BinMapper._fix_zero_boundary(bounds, distinct)
+        return bounds
+
+    @staticmethod
+    def _fix_zero_boundary(bounds: np.ndarray, distinct: np.ndarray) -> np.ndarray:
+        """Insert boundaries at +/-kZeroThreshold so zero sits alone-ish in its bin
+        when both negative and positive neighbors exist (reference keeps zero
+        separable for sparse/missing handling)."""
+        has_neg = distinct[0] < -K_ZERO_THRESHOLD
+        has_pos = distinct[-1] > K_ZERO_THRESHOLD
+        has_zero = np.any(np.abs(distinct) < K_ZERO_THRESHOLD)
+        if not has_zero:
+            return bounds
+        add = []
+        if has_neg:
+            add.append(-K_ZERO_THRESHOLD)
+        if has_pos:
+            add.append(K_ZERO_THRESHOLD)
+        if add:
+            bounds = np.unique(np.concatenate([bounds, add]))
+            # drop any other boundary that falls inside (-thr, thr)
+            inside = (np.abs(bounds) < K_ZERO_THRESHOLD)
+            bounds = bounds[~inside]
+        return bounds
+
+    @staticmethod
+    def _categorical_from_sample(
+        values: np.ndarray, total_cnt: int, max_bin: int,
+        min_data_in_bin: int, use_missing: bool,
+    ) -> "BinMapper":
+        na_mask = np.isnan(values) | (values < 0)
+        if np.any(values < 0):
+            log.warning("negative categorical value found; treated as missing")
+        cats = values[~na_mask].astype(np.int64)
+        implicit_zeros = max(0, total_cnt - len(values))
+        if implicit_zeros:
+            cats = np.concatenate([cats, np.zeros(implicit_zeros, dtype=np.int64)])
+        distinct, counts = np.unique(cats, return_counts=True)
+        order = np.argsort(-counts, kind="stable")
+        distinct, counts = distinct[order], counts[order]
+        # cut rare categories: keep at most max_bin-1 cats and drop ultra-rare tail
+        # (reference caps categories and filters low-count ones, src/io/bin.cpp)
+        keep = min(len(distinct), max_bin - 1)
+        cum = np.cumsum(counts)
+        total = cum[-1] if len(cum) else 0
+        while keep > 1 and counts[keep - 1] < min_data_in_bin and cum[keep - 1] > 0.99 * total:
+            keep -= 1
+        distinct = distinct[:keep]
+        m = BinMapper(
+            num_bins=max(1, keep + 1),  # bin 0 = other/missing, bins 1..keep = cats
+            bin_type=BIN_CATEGORICAL,
+            missing_type=MISSING_NAN if use_missing else MISSING_NONE,
+            cat_values=distinct,
+        )
+        m.is_trivial = keep <= 1 and len(np.unique(cats)) <= 1
+        m.default_bin = 0
+        return m
+
+    # ---- value -> bin ----
+    def _value_to_bin_scalar(self, v: float) -> int:
+        return int(self.values_to_bins(np.array([v]))[0])
+
+    def values_to_bins(self, values: np.ndarray) -> np.ndarray:
+        """Vectorized value->bin (reference: BinMapper::ValueToBin, bin.h:485)."""
+        values = np.asarray(values, dtype=np.float64)
+        if self.bin_type == BIN_CATEGORICAL:
+            out = np.zeros(len(values), dtype=np.int32)
+            lut: Dict[int, int] = {int(c): i + 1 for i, c in enumerate(self.cat_values)}
+            iv = np.where(np.isnan(values) | (values < 0), -1, values).astype(np.int64)
+            for cat, b in lut.items():
+                out[iv == cat] = b
+            return out
+        n_numeric = self.num_bins - (1 if self.missing_type == MISSING_NAN else 0)
+        bounds = self.upper_bounds[:n_numeric]
+        na = np.isnan(values)
+        v = np.where(na, 0.0, values)
+        if self.missing_type != MISSING_NAN:
+            # NaN coerced to zero bin (reference converts NaN->0 when no NaN bin)
+            v = np.where(na, 0.0, v)
+        # bin b <=> v <= bounds[b] (bounds strictly increasing, last is inf)
+        out = np.searchsorted(bounds[:-1], v, side="left").astype(np.int32)
+        # searchsorted(side=left) puts v == bound into that bin: we need v <= bound
+        gt = v > np.take(bounds, np.minimum(out, len(bounds) - 1))
+        out = np.where(gt, out + 1, out)
+        out = np.minimum(out, n_numeric - 1)
+        if self.missing_type == MISSING_NAN:
+            out = np.where(na, self.num_bins - 1, out)
+        return out.astype(np.int32)
+
+    def bin_to_value(self, b: int) -> float:
+        """Representative threshold value for bin b (its upper bound)."""
+        if self.bin_type == BIN_CATEGORICAL:
+            return float(self.cat_values[b - 1]) if 1 <= b <= len(self.cat_values) else -1.0
+        n_numeric = self.num_bins - (1 if self.missing_type == MISSING_NAN else 0)
+        b = min(b, n_numeric - 1)
+        return float(self.upper_bounds[b])
+
+    def to_feature_info(self) -> str:
+        """Feature info string for model files (reference: model text 'feature_infos')."""
+        if self.is_trivial:
+            return "none"
+        if self.bin_type == BIN_CATEGORICAL:
+            return ":".join(str(int(c)) for c in self.cat_values)
+        return f"[{self.min_value}:{self.max_value}]"
+
+
+@dataclass
+class BinnedDataset:
+    """Host-side container for the binned matrix + per-feature mappers."""
+
+    bins: np.ndarray                 # [N, F] uint8
+    mappers: List[BinMapper]
+    raw_num_features: int            # features before dropping trivials
+    feature_map: np.ndarray          # used column -> original feature index
+
+    @property
+    def num_data(self) -> int:
+        return self.bins.shape[0]
+
+    @property
+    def num_features(self) -> int:
+        return self.bins.shape[1]
+
+    @property
+    def max_num_bins(self) -> int:
+        return max((m.num_bins for m in self.mappers), default=1)
+
+
+def find_bin_mappers(
+    data: np.ndarray,
+    max_bin: int,
+    min_data_in_bin: int = 3,
+    sample_cnt: int = 200000,
+    categorical: Optional[Sequence[int]] = None,
+    use_missing: bool = True,
+    zero_as_missing: bool = False,
+    seed: int = 1,
+    forced_bins: Optional[Dict[int, Sequence[float]]] = None,
+) -> List[BinMapper]:
+    """Find per-feature bin mappers from a row sample of ``data`` [N, F]."""
+    n, f = data.shape
+    rng = np.random.RandomState(seed)
+    if n > sample_cnt:
+        idx = rng.choice(n, sample_cnt, replace=False)
+        sample = data[idx]
+    else:
+        sample = data
+    cats = set(categorical or ())
+    mappers = []
+    for j in range(f):
+        mappers.append(BinMapper.from_sample(
+            sample[:, j], len(sample), max_bin,
+            min_data_in_bin=min_data_in_bin,
+            bin_type=BIN_CATEGORICAL if j in cats else BIN_NUMERICAL,
+            use_missing=use_missing,
+            zero_as_missing=zero_as_missing,
+            forced_bounds=(forced_bins or {}).get(j),
+        ))
+    return mappers
+
+
+def bin_data(
+    data: np.ndarray,
+    mappers: List[BinMapper],
+    keep_trivial: bool = False,
+) -> BinnedDataset:
+    """Encode raw feature matrix into the dense uint8 binned matrix."""
+    n, f = data.shape
+    used = [j for j in range(f) if keep_trivial or not mappers[j].is_trivial]
+    if not used:
+        used = [0] if f else []
+    out = np.zeros((n, len(used)), dtype=np.uint8)
+    for k, j in enumerate(used):
+        b = mappers[j].values_to_bins(data[:, j])
+        if mappers[j].num_bins > 256:
+            log.fatal(f"feature {j}: {mappers[j].num_bins} bins > 256 unsupported")
+        out[:, k] = b.astype(np.uint8)
+    return BinnedDataset(
+        bins=out,
+        mappers=[mappers[j] for j in used],
+        raw_num_features=f,
+        feature_map=np.array(used, dtype=np.int32),
+    )

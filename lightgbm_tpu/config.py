@@ -1,0 +1,305 @@
+"""Parameter/config system.
+
+TPU-native re-design of the reference config layer (include/LightGBM/config.h:31,
+src/io/config.cpp:186, generated alias table src/io/config_auto.cpp:10): a single flat
+``Config`` object with typed fields, an alias table resolved before parsing, and
+``key=value`` string parsing for CLI/config-file use.  Unlike the reference (which
+generates the parser from structured header comments), the registry below *is* the
+single source of truth: fields, defaults, aliases and docs all live in ``_PARAMS``.
+"""
+from __future__ import annotations
+
+import copy
+from typing import Any, Dict, Iterable, List, Optional, Tuple
+
+from .utils import log
+
+# name: (default, aliases)
+# Mirrors the parameter surface of the reference (config.h:31-1075). Types are inferred
+# from the defaults; None-typed entries carry an explicit type tag in _TYPES below.
+_PARAMS: Dict[str, Tuple[Any, Tuple[str, ...]]] = {
+    # ---- core ----
+    "config": ("", ("config_file",)),
+    "task": ("train", ("task_type",)),
+    "objective": ("regression", ("objective_type", "app", "application", "loss")),
+    "boosting": ("gbdt", ("boosting_type", "boost")),
+    "data": ("", ("train", "train_data", "train_data_file", "data_filename")),
+    "valid": ([], ("test", "valid_data", "valid_data_file", "test_data", "test_data_file", "valid_filenames")),
+    "num_iterations": (100, ("num_iteration", "n_iter", "num_tree", "num_trees", "num_round", "num_rounds", "num_boost_round", "n_estimators")),
+    "learning_rate": (0.1, ("shrinkage_rate", "eta")),
+    "num_leaves": (31, ("num_leaf", "max_leaves", "max_leaf")),
+    "tree_learner": ("serial", ("tree", "tree_type", "tree_learner_type")),
+    "num_threads": (0, ("num_thread", "nthread", "nthreads", "n_jobs")),
+    "device_type": ("tpu", ("device",)),
+    "seed": (None, ("random_seed", "random_state")),
+    # ---- learning control ----
+    "force_col_wise": (False, ()),
+    "force_row_wise": (False, ()),
+    "max_depth": (-1, ()),
+    "min_data_in_leaf": (20, ("min_data_per_leaf", "min_data", "min_child_samples")),
+    "min_sum_hessian_in_leaf": (1e-3, ("min_sum_hessian_per_leaf", "min_sum_hessian", "min_hessian", "min_child_weight")),
+    "bagging_fraction": (1.0, ("sub_row", "subsample", "bagging")),
+    "pos_bagging_fraction": (1.0, ("pos_sub_row", "pos_subsample", "pos_bagging")),
+    "neg_bagging_fraction": (1.0, ("neg_sub_row", "neg_subsample", "neg_bagging")),
+    "bagging_freq": (0, ("subsample_freq",)),
+    "bagging_seed": (3, ("bagging_fraction_seed",)),
+    "feature_fraction": (1.0, ("sub_feature", "colsample_bytree")),
+    "feature_fraction_bynode": (1.0, ("sub_feature_bynode", "colsample_bynode")),
+    "feature_fraction_seed": (2, ()),
+    "early_stopping_round": (0, ("early_stopping_rounds", "early_stopping", "n_iter_no_change")),
+    "first_metric_only": (False, ()),
+    "max_delta_step": (0.0, ("max_tree_output", "max_leaf_output")),
+    "lambda_l1": (0.0, ("reg_alpha",)),
+    "lambda_l2": (0.0, ("reg_lambda", "lambda")),
+    "min_gain_to_split": (0.0, ("min_split_gain",)),
+    "drop_rate": (0.1, ("rate_drop",)),
+    "max_drop": (50, ()),
+    "skip_drop": (0.5, ()),
+    "xgboost_dart_mode": (False, ()),
+    "uniform_drop": (False, ()),
+    "drop_seed": (4, ()),
+    "top_rate": (0.2, ()),
+    "other_rate": (0.1, ()),
+    "min_data_per_group": (100, ()),
+    "max_cat_threshold": (32, ()),
+    "cat_l2": (10.0, ()),
+    "cat_smooth": (10.0, ()),
+    "max_cat_to_onehot": (4, ()),
+    "top_k": (20, ("topk",)),
+    "monotone_constraints": ([], ("mc", "monotone_constraint")),
+    "feature_contri": ([], ("feature_contrib", "fc", "fp", "feature_penalty")),
+    "forcedsplits_filename": ("", ("fs", "forced_splits_filename", "forced_splits_file", "forced_splits")),
+    "forcedbins_filename": ("", ()),
+    "refit_decay_rate": (0.9, ()),
+    "cegb_tradeoff": (1.0, ()),
+    "cegb_penalty_split": (0.0, ()),
+    "cegb_penalty_feature_lazy": ([], ()),
+    "cegb_penalty_feature_coupled": ([], ()),
+    "verbosity": (1, ("verbose",)),
+    # ---- dataset ----
+    "max_bin": (255, ("max_bins",)),
+    "min_data_in_bin": (3, ()),
+    "bin_construct_sample_cnt": (200000, ("subsample_for_bin",)),
+    "histogram_pool_size": (-1.0, ("hist_pool_size",)),
+    "data_random_seed": (1, ("data_seed",)),
+    "output_model": ("LightGBM_model.txt", ("model_output", "model_out")),
+    "snapshot_freq": (-1, ("save_period",)),
+    "input_model": ("", ("model_input", "model_in")),
+    "output_result": ("LightGBM_predict_result.txt", ("predict_result", "prediction_result", "predict_name", "prediction_name", "pred_name", "name_pred")),
+    "initscore_filename": ("", ("init_score_filename", "init_score_file", "init_score", "input_init_score")),
+    "valid_data_initscores": ([], ("valid_init_score_file", "init_score_file", "valid_init_score")),
+    "pre_partition": (False, ("is_pre_partition",)),
+    "enable_bundle": (True, ("is_enable_bundle", "bundle")),
+    "max_conflict_rate": (0.0, ()),
+    "is_enable_sparse": (True, ("is_sparse", "enable_sparse", "sparse")),
+    "sparse_threshold": (0.8, ()),
+    "use_missing": (True, ()),
+    "zero_as_missing": (False, ()),
+    "two_round": (False, ("two_round_loading", "use_two_round_loading")),
+    "save_binary": (False, ("is_save_binary", "is_save_binary_file")),
+    "header": (False, ("has_header",)),
+    "label_column": ("", ("label",)),
+    "weight_column": ("", ("weight",)),
+    "group_column": ("", ("group", "group_id", "query_column", "query", "query_id")),
+    "ignore_column": ("", ("ignore_feature", "blacklist")),
+    "categorical_feature": ("", ("cat_feature", "categorical_column", "cat_column")),
+    # ---- predict ----
+    "predict_raw_score": (False, ("is_predict_raw_score", "predict_rawscore", "raw_score")),
+    "predict_leaf_index": (False, ("is_predict_leaf_index", "leaf_index")),
+    "predict_contrib": (False, ("is_predict_contrib", "contrib")),
+    "num_iteration_predict": (-1, ()),
+    "pred_early_stop": (False, ()),
+    "pred_early_stop_freq": (10, ()),
+    "pred_early_stop_margin": (10.0, ()),
+    # ---- convert ----
+    "convert_model_language": ("", ()),
+    "convert_model": ("gbdt_prediction.cpp", ("convert_model_file",)),
+    # ---- objective ----
+    "num_class": (1, ("num_classes",)),
+    "is_unbalance": (False, ("unbalance", "unbalanced_sets")),
+    "scale_pos_weight": (1.0, ()),
+    "sigmoid": (1.0, ()),
+    "boost_from_average": (True, ()),
+    "reg_sqrt": (False, ()),
+    "alpha": (0.9, ()),
+    "fair_c": (1.0, ()),
+    "poisson_max_delta_step": (0.7, ()),
+    "tweedie_variance_power": (1.5, ()),
+    "lambdarank_truncation_level": (20, ("max_position",)),
+    "lambdarank_norm": (True, ()),
+    "label_gain": ([], ()),
+    # ---- metric ----
+    "metric": ([], ("metrics", "metric_types")),
+    "metric_freq": (1, ("output_freq",)),
+    "is_provide_training_metric": (False, ("training_metric", "is_training_metric", "train_metric")),
+    "eval_at": ([1, 2, 3, 4, 5], ("ndcg_eval_at", "ndcg_at", "map_eval_at", "map_at")),
+    # ---- network ----
+    "num_machines": (1, ("num_machine",)),
+    "local_listen_port": (12400, ("local_port", "port")),
+    "time_out": (120, ()),
+    "machine_list_filename": ("", ("machine_list_file", "machine_list", "mlist")),
+    "machines": ("", ("workers", "nodes")),
+    # ---- GPU/TPU device ----
+    "gpu_platform_id": (-1, ()),
+    "gpu_device_id": (-1, ()),
+    "gpu_use_dp": (False, ()),
+    # ---- TPU-specific (new in this framework) ----
+    "histogram_impl": ("auto", ()),        # auto | onehot | scatter | pallas
+    "grow_policy": ("lossguide", ()),      # lossguide (leaf-wise, reference default) | depthwise
+    "hist_dtype": ("float32", ()),         # histogram accumulator dtype
+    "mesh_axis": ("data", ()),             # mesh axis name for data-parallel sharding
+}
+
+_LIST_FLOAT = {"feature_contri", "cegb_penalty_feature_lazy", "cegb_penalty_feature_coupled", "label_gain"}
+_LIST_INT = {"monotone_constraints", "eval_at"}
+_LIST_STR = {"valid", "metric", "valid_data_initscores"}
+_MAYBE_INT = {"seed"}
+
+# alias -> canonical name
+_ALIASES: Dict[str, str] = {}
+for _name, (_default, _aliases) in _PARAMS.items():
+    for _a in _aliases:
+        _ALIASES.setdefault(_a, _name)
+
+
+def canonical_name(key: str) -> str:
+    key = key.strip()
+    return _ALIASES.get(key, key)
+
+
+def _parse_bool(v: Any) -> bool:
+    if isinstance(v, bool):
+        return v
+    s = str(v).strip().lower()
+    if s in ("true", "+", "1", "yes", "on"):
+        return True
+    if s in ("false", "-", "0", "no", "off"):
+        return False
+    log.fatal(f"cannot parse bool value: {v!r}")
+
+
+def _parse_list(v: Any, elem) -> List:
+    if isinstance(v, (list, tuple)):
+        return [elem(x) for x in v]
+    s = str(v).strip()
+    if not s:
+        return []
+    return [elem(x) for x in s.replace(" ", ",").split(",") if x != ""]
+
+
+def _coerce(name: str, value: Any) -> Any:
+    default = _PARAMS[name][0]
+    if name in _LIST_FLOAT:
+        return _parse_list(value, float)
+    if name in _LIST_INT:
+        return _parse_list(value, int)
+    if name in _LIST_STR:
+        return _parse_list(value, str)
+    if name in _MAYBE_INT:
+        return None if value is None or value == "" else int(value)
+    if isinstance(default, bool):
+        return _parse_bool(value)
+    if isinstance(default, int):
+        return int(float(value)) if not isinstance(value, int) else value
+    if isinstance(default, float):
+        return float(value)
+    return str(value)
+
+
+class Config:
+    """Flat typed config (reference: struct Config, config.h:31).
+
+    Construct from a dict (Python API) or ``key=value`` strings (CLI). Unknown keys
+    are kept in ``self.extra`` so user callbacks / custom objectives can see them.
+    """
+
+    def __init__(self, params: Optional[Dict[str, Any]] = None, **kwargs):
+        for name, (default, _a) in _PARAMS.items():
+            setattr(self, name, copy.copy(default))
+        self.extra: Dict[str, Any] = {}
+        merged = dict(params or {})
+        merged.update(kwargs)
+        self.update(merged)
+
+    def update(self, params: Dict[str, Any]) -> "Config":
+        resolved: Dict[str, Any] = {}
+        for key, value in params.items():
+            name = canonical_name(key)
+            if name in resolved and resolved[name] != value:
+                log.warning(f"{key} is set with {value}, will be overridden by earlier setting of {name}. Current value: {resolved[name]}")
+                continue
+            resolved.setdefault(name, value)
+        for name, value in resolved.items():
+            if name in _PARAMS:
+                if value is None and name not in _MAYBE_INT:
+                    continue
+                setattr(self, name, _coerce(name, value))
+            else:
+                self.extra[name] = value
+        self._post_process()
+        return self
+
+    def _post_process(self) -> None:
+        if self.verbosity >= 2:
+            log.set_level(log.DEBUG)
+        elif self.verbosity == 1:
+            log.set_level(log.INFO)
+        elif self.verbosity == 0:
+            log.set_level(log.WARNING)
+        else:
+            log.set_level(log.FATAL)
+        # seed fans out to sub-seeds like the reference (config.cpp:310-320)
+        if self.seed is not None:
+            self.data_random_seed = self.seed + 1
+            self.bagging_seed = self.seed + 2
+            self.drop_seed = self.seed + 3
+            self.feature_fraction_seed = self.seed + 4
+        if self.num_leaves < 2:
+            log.fatal("num_leaves must be >= 2")
+        if self.max_bin > 256:
+            log.warning("max_bin > 256 not supported on TPU (uint8 bins); clamping to 256")
+            self.max_bin = 256
+
+    def to_dict(self) -> Dict[str, Any]:
+        out = {name: getattr(self, name) for name in _PARAMS}
+        out.update(self.extra)
+        return out
+
+    def copy(self) -> "Config":
+        c = Config()
+        for name in _PARAMS:
+            setattr(c, name, copy.copy(getattr(self, name)))
+        c.extra = dict(self.extra)
+        return c
+
+    # ---- string / file parsing (reference: Config::Str2Map config.h:78) ----
+    @staticmethod
+    def str2map(args: Iterable[str]) -> Dict[str, str]:
+        out: Dict[str, str] = {}
+        for arg in args:
+            arg = arg.strip()
+            if not arg or arg.startswith("#"):
+                continue
+            if "=" in arg:
+                k, v = arg.split("=", 1)
+                # strip inline comments
+                v = v.split("#", 1)[0]
+                out[k.strip()] = v.strip()
+        return out
+
+    @classmethod
+    def from_cli(cls, argv: List[str]) -> "Config":
+        kv = cls.str2map(argv)
+        conf_path = kv.get("config", kv.get("config_file", ""))
+        if conf_path:
+            with open(conf_path) as f:
+                file_kv = cls.str2map(f.readlines())
+            file_kv.update({k: v for k, v in kv.items() if k not in ("config", "config_file")})
+            kv = file_kv
+        return cls(kv)
+
+
+def params_to_config(params: Optional[Dict[str, Any]]) -> Config:
+    if isinstance(params, Config):
+        return params.copy()
+    return Config(params)

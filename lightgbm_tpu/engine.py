@@ -1,0 +1,286 @@
+"""Training entry points: ``train()`` and ``cv()``.
+
+Mirrors the reference python package's engine (python-package/lightgbm/engine.py:18
+train, :375 cv): callback orchestration before/after each iteration, valid-set
+alignment to the train set, early stopping, continued training from an init model.
+"""
+from __future__ import annotations
+
+import copy
+from typing import Any, Callable, Dict, List, Optional, Union
+
+import numpy as np
+
+from . import callback as cb
+from .basic import Booster, Dataset
+from .config import Config, params_to_config
+from .utils import log
+
+
+def train(params: Dict[str, Any], train_set: Dataset,
+          num_boost_round: int = 100,
+          valid_sets: Optional[List[Dataset]] = None,
+          valid_names: Optional[List[str]] = None,
+          fobj: Optional[Callable] = None,
+          feval: Optional[Callable] = None,
+          init_model: Optional[Union[str, Booster]] = None,
+          feature_name: Union[str, List[str]] = "auto",
+          categorical_feature: Union[str, List] = "auto",
+          early_stopping_rounds: Optional[int] = None,
+          evals_result: Optional[Dict] = None,
+          verbose_eval: Union[bool, int] = True,
+          keep_training_booster: bool = False,
+          callbacks: Optional[List[Callable]] = None) -> Booster:
+    """Train a booster (reference: engine.py:18)."""
+    params = dict(params or {})
+    conf = params_to_config(params)
+    if conf.num_iterations != 100 and num_boost_round == 100:
+        num_boost_round = conf.num_iterations
+    if conf.early_stopping_round and early_stopping_rounds is None:
+        early_stopping_rounds = conf.early_stopping_round
+    if fobj is not None:
+        params["objective"] = "none"
+
+    if feature_name != "auto":
+        train_set.feature_name = feature_name
+    if categorical_feature != "auto":
+        train_set.categorical_feature = categorical_feature
+
+    booster = Booster(params=params, train_set=train_set)
+    if init_model is not None:
+        _warm_start(booster, init_model)
+
+    valid_sets = valid_sets or []
+    valid_names = valid_names or []
+    for i, vs in enumerate(valid_sets):
+        if vs is train_set:
+            name = valid_names[i] if i < len(valid_names) else "training"
+            booster._gbdt.metrics = booster._gbdt.metrics  # training eval flag below
+            _train_as_valid = True
+            booster._eval_training = True
+            continue
+        name = valid_names[i] if i < len(valid_names) else f"valid_{i}"
+        if vs.reference is not train_set:
+            vs.reference = train_set
+        booster.add_valid(vs, name)
+    eval_training = any(vs is train_set for vs in valid_sets) \
+        or conf.is_provide_training_metric
+
+    callbacks = list(callbacks or [])
+    if early_stopping_rounds is not None and early_stopping_rounds > 0:
+        callbacks.append(cb.early_stopping(early_stopping_rounds,
+                                           conf.first_metric_only,
+                                           verbose=bool(verbose_eval)))
+    if verbose_eval is True:
+        callbacks.append(cb.print_evaluation())
+    elif isinstance(verbose_eval, int) and verbose_eval > 1:
+        callbacks.append(cb.print_evaluation(verbose_eval))
+    if evals_result is not None:
+        callbacks.append(cb.record_evaluation(evals_result))
+
+    callbacks_before = [c for c in callbacks if getattr(c, "before_iteration", False)]
+    callbacks_after = [c for c in callbacks if not getattr(c, "before_iteration", False)]
+    callbacks_before.sort(key=lambda c: getattr(c, "order", 0))
+    callbacks_after.sort(key=lambda c: getattr(c, "order", 0))
+
+    begin_iteration = booster.current_iteration
+    end_iteration = begin_iteration + num_boost_round
+    finished = False
+    try:
+        for i in range(begin_iteration, end_iteration):
+            for c in callbacks_before:
+                c(cb.CallbackEnv(model=booster, params=params, iteration=i,
+                                 begin_iteration=begin_iteration,
+                                 end_iteration=end_iteration,
+                                 evaluation_result_list=None))
+            finished = booster.update(fobj=fobj)
+            evaluation_result_list = []
+            if booster._gbdt.valid_sets or eval_training:
+                if eval_training:
+                    evaluation_result_list.extend(booster.eval_train())
+                evaluation_result_list.extend(booster.eval_valid())
+                if feval is not None:
+                    evaluation_result_list.extend(
+                        _run_feval(feval, booster, train_set, eval_training))
+            for c in callbacks_after:
+                c(cb.CallbackEnv(model=booster, params=params, iteration=i,
+                                 begin_iteration=begin_iteration,
+                                 end_iteration=end_iteration,
+                                 evaluation_result_list=evaluation_result_list))
+            if finished:
+                log.warning("Stopped training because there are no more leaves "
+                            "that meet the split requirements")
+                break
+    except cb.EarlyStopException as e:
+        booster.best_iteration = e.best_iteration + 1
+        for item in (e.best_score or []):
+            booster.best_score.setdefault(item[0], {})[item[1]] = item[2]
+    booster._ensure_host_trees()
+    return booster
+
+
+def _run_feval(feval, booster, train_set, eval_training):
+    out = []
+    fevals = feval if isinstance(feval, (list, tuple)) else [feval]
+    gb = booster._gbdt
+    for f in fevals:
+        datasets = ([("training", gb.train_score, gb.train_set)] if eval_training else [])
+        datasets += list(zip(gb.valid_names, gb.valid_scores, gb.valid_sets))
+        for name, score, ds in datasets:
+            res = f(np.asarray(score), ds)
+            if isinstance(res, tuple):
+                res = [res]
+            for metric_name, value, greater_is_better in res:
+                out.append((name, metric_name, value, greater_is_better))
+    return out
+
+
+def _warm_start(booster: Booster, init_model: Union[str, Booster]) -> None:
+    """Continued training (reference: engine.py:160 _InnerPredictor): bake the old
+    model's raw predictions into the new booster's scores as init scores."""
+    if isinstance(init_model, str):
+        init = Booster(model_file=init_model)
+    else:
+        init = init_model
+    gb = booster._gbdt
+    ts = booster.train_set
+    # previous model predictions on the *binned* train matrix -> init scores
+    raw_train = _predict_via_trees(init, ts)
+    gb.train_score = gb.train_score + raw_train
+    gb._has_init_score = True
+
+
+def _predict_via_trees(init_booster: Booster, dataset) -> np.ndarray:
+    import jax.numpy as jnp
+    from .models.tree import stack_trees
+    from .ops import predict as P
+    trees = init_booster._ensure_host_trees()
+    if not trees:
+        return 0.0
+    k = init_booster.num_model_per_iteration()
+    # route binned columns through real-valued thresholds is wrong; instead we
+    # predict leaf-by-leaf on the raw data if available, else via bin thresholds
+    # mapped back. Datasets constructed from arrays retain no raw copy, so use the
+    # device route on bin-space after re-mapping thresholds to bins.
+    mappers = dataset.mappers
+    fm = dataset.feature_map
+    inv = {int(orig): used for used, orig in enumerate(fm)} if fm is not None else None
+    import numpy as _np
+    # map real thresholds to bin thresholds per node
+    stacked = stack_trees(trees, dataset.num_features, dataset.max_num_bins)
+    sf = stacked["split_feature"].copy()
+    tb = stacked["threshold_bin"].copy()
+    for ti, t in enumerate(trees):
+        for ni in range(t.num_leaves - 1):
+            orig = int(t.split_feature[ni])
+            used = inv.get(orig, 0) if inv is not None else orig
+            m = mappers[used]
+            tb[ti, ni] = int(m.values_to_bins(_np.array([t.threshold_real[ni]]))[0])
+            sf[ti, ni] = used
+    stacked["split_feature"] = sf
+    stacked["threshold_bin"] = tb
+    stack_dev = {kk: jnp.asarray(v) for kk, v in stacked.items()}
+    max_steps = max(int(stacked["num_leaves"].max()) - 1, 1)
+    out = P.predict_bins_ensemble(stack_dev, dataset.bins, dataset.na_bin_dev, max_steps)
+    if k > 1:
+        # per-class: route class trees separately
+        outs = []
+        for cls in range(k):
+            sub = {kk: v[cls::k] for kk, v in stack_dev.items()}
+            outs.append(P.predict_bins_ensemble(sub, dataset.bins,
+                                                dataset.na_bin_dev, max_steps))
+        return _np.stack([_np.asarray(o) for o in outs], axis=1)
+    return _np.asarray(out)
+
+
+def cv(params: Dict[str, Any], train_set: Dataset, num_boost_round: int = 100,
+       folds=None, nfold: int = 5, stratified: bool = True, shuffle: bool = True,
+       metrics=None, fobj=None, feval=None, init_model=None,
+       feature_name="auto", categorical_feature="auto",
+       early_stopping_rounds: Optional[int] = None,
+       fpreproc=None, verbose_eval=None, show_stdv: bool = True, seed: int = 0,
+       callbacks=None, eval_train_metric: bool = False,
+       return_cvbooster: bool = False) -> Dict[str, List[float]]:
+    """K-fold cross-validation (reference: engine.py:375 cv, _make_n_folds :299)."""
+    params = dict(params or {})
+    if metrics is not None:
+        params["metric"] = metrics
+    conf = params_to_config(params)
+    if conf.num_iterations != 100 and num_boost_round == 100:
+        num_boost_round = conf.num_iterations
+    train_set.construct()
+    label = np.asarray(train_set.label)
+    n = train_set.num_data
+
+    if folds is None:
+        rng = np.random.RandomState(seed)
+        if stratified and conf.objective in ("binary", "multiclass", "multiclassova"):
+            from sklearn.model_selection import StratifiedKFold
+            skf = StratifiedKFold(n_splits=nfold, shuffle=shuffle,
+                                  random_state=seed if shuffle else None)
+            folds = list(skf.split(np.zeros(n), label))
+        else:
+            idx = rng.permutation(n) if shuffle else np.arange(n)
+            folds = [(np.setdiff1d(idx, part, assume_unique=False), part)
+                     for part in np.array_split(idx, nfold)]
+
+    # cv needs raw data: keep a reference before construct frees it
+    raw = train_set.raw_data
+    if raw is None:
+        log.fatal("cv requires Dataset(free_raw_data=False)")
+    raw = _np2(raw)
+    weight = train_set.get_weight()
+
+    boosters = []
+    for (tr_idx, va_idx) in folds:
+        dtr = Dataset(raw[tr_idx], label=label[tr_idx],
+                      weight=None if weight is None else weight[tr_idx],
+                      params=params,
+                      categorical_feature=train_set.categorical_feature)
+        dva_data = raw[va_idx]
+        bst = Booster(params=params, train_set=dtr)
+        dva = dtr.create_valid(dva_data, label=label[va_idx],
+                               weight=None if weight is None else weight[va_idx])
+        bst.add_valid(dva, "valid")
+        boosters.append(bst)
+
+    results: Dict[str, List[float]] = {}
+    best = [None]
+    best_iter = [0]
+    for i in range(num_boost_round):
+        allres = {}
+        for bst in boosters:
+            bst.update(fobj=fobj)
+            for name, metric, val, gib in bst.eval_valid():
+                allres.setdefault((metric, gib), []).append(val)
+        res_list = []
+        for (metric, gib), vals in allres.items():
+            mean, std = float(np.mean(vals)), float(np.std(vals))
+            results.setdefault(f"{metric}-mean", []).append(mean)
+            results.setdefault(f"{metric}-stdv", []).append(std)
+            res_list.append(("cv_agg", metric, mean, gib, std))
+        if verbose_eval:
+            log.info(f"[{i + 1}]\t" + "\t".join(
+                cb._format_eval_result(r, show_stdv) for r in res_list))
+        if early_stopping_rounds:
+            metric_key, greater_is_better = next(iter(allres))
+            mean = float(np.mean(allres[(metric_key, greater_is_better)]))
+            improved = (best[0] is None
+                        or (mean > best[0] if greater_is_better else mean < best[0]))
+            if improved:
+                best[0], best_iter[0] = mean, i
+            elif i - best_iter[0] >= early_stopping_rounds:
+                for k in results:
+                    results[k] = results[k][: best_iter[0] + 1]
+                break
+    if return_cvbooster:
+        results["cvbooster"] = boosters
+    return results
+
+
+def _np2(data):
+    import pandas as pd
+    if isinstance(data, pd.DataFrame):
+        return data.to_numpy(dtype=np.float64, na_value=np.nan)
+    a = np.asarray(data, dtype=np.float64)
+    return a.reshape(-1, 1) if a.ndim == 1 else a

@@ -1,0 +1,170 @@
+"""Model text/JSON serialization, reference-format compatible.
+
+Re-implements the reference's model file format (src/boosting/gbdt_model_text.cpp:
+SaveModelToString :271, LoadModelFromString :375, JSON dump :20) so that models
+trained here can be inspected by LightGBM-ecosystem tooling and vice versa.
+"""
+from __future__ import annotations
+
+import json
+from typing import Dict, List, Tuple
+
+import numpy as np
+
+from ..models.tree import Tree
+from ..utils import log
+
+_VERSION = "v3"
+
+
+def _objective_string(booster) -> str:
+    conf = booster.config
+    obj = booster._loaded_meta.get("objective") if booster._loaded_meta else None
+    if obj:
+        return obj
+    name = conf.objective
+    extras = []
+    if name in ("multiclass", "multiclassova", "softmax", "ova", "ovr"):
+        extras.append(f"num_class:{conf.num_class}")
+    if name in ("binary", "multiclassova"):
+        extras.append(f"sigmoid:{conf.sigmoid:g}")
+    if name in ("lambdarank",):
+        extras.append(f"lambdarank_truncation_level:{conf.lambdarank_truncation_level}")
+    return " ".join([name] + extras)
+
+
+def dump_model_text(booster, trees: List[Tree], num_iteration: int = -1,
+                    start_iteration: int = 0) -> str:
+    k = booster.num_model_per_iteration()
+    if num_iteration and num_iteration > 0:
+        trees = trees[: num_iteration * k]
+    trees = trees[start_iteration * k:]
+    names = booster.feature_name()
+    if booster.train_set is not None:
+        infos = ["none"] * len(names)
+        fm = booster.train_set.feature_map
+        for used_idx, m in enumerate(booster.train_set.mappers):
+            orig = int(fm[used_idx]) if fm is not None else used_idx
+            if orig < len(infos):
+                infos[orig] = m.to_feature_info()
+        max_feature_idx = len(names) - 1
+    else:
+        infos = booster._loaded_meta.get("feature_infos", ["none"] * len(names))
+        max_feature_idx = int(booster._loaded_meta.get("max_feature_idx", len(names) - 1))
+
+    lines = [
+        "tree",
+        f"version={_VERSION}",
+        f"num_class={booster.config.num_class}",
+        f"num_tree_per_iteration={k}",
+        "label_index=0",
+        f"max_feature_idx={max_feature_idx}",
+        f"objective={_objective_string(booster)}",
+        "average_output" if booster._avg_output() else None,
+        f"feature_names={' '.join(names)}",
+        f"feature_infos={' '.join(infos)}",
+        "",
+    ]
+    lines = [l for l in lines if l is not None]
+
+    tree_blocks = [t.to_string(i) for i, t in enumerate(trees)]
+    tree_sizes = [len(b) + 1 for b in tree_blocks]  # +1: blank separator line
+    lines.insert(len(lines) - 1, f"tree_sizes={' '.join(str(s) for s in tree_sizes)}")
+
+    body = "\n".join(lines) + "\n".join(tree_blocks) + "\nend of trees\n"
+
+    # feature importances (split counts), like the reference's footer
+    imp = {}
+    for t in trees:
+        for i in range(t.num_leaves - 1):
+            f = int(t.split_feature[i])
+            imp[f] = imp.get(f, 0) + 1
+    pairs = sorted(imp.items(), key=lambda kv: (-kv[1], kv[0]))
+    body += "\nfeature importances:\n"
+    for f, c in pairs:
+        nm = names[f] if f < len(names) else f"Column_{f}"
+        body += f"{nm}={c}\n"
+    body += "\nparameters:\n"
+    for key, val in sorted(booster.params.items()):
+        body += f"[{key}: {val}]\n"
+    body += "end of parameters\n\npandas_categorical:null\n"
+    return body
+
+
+def parse_model_text(s: str) -> Tuple[Dict, List[Tree]]:
+    header, _, rest = s.partition("\nTree=")
+    meta: Dict = {}
+    for line in header.splitlines():
+        line = line.strip()
+        if not line or line == "tree":
+            continue
+        if line == "average_output":
+            meta["average_output"] = True
+            continue
+        if "=" in line:
+            key, val = line.split("=", 1)
+            meta[key] = val
+    if "feature_names" in meta:
+        meta["feature_names"] = meta["feature_names"].split(" ")
+    if "feature_infos" in meta:
+        meta["feature_infos"] = meta["feature_infos"].split(" ")
+    for key in ("num_class", "num_tree_per_iteration", "max_feature_idx", "label_index"):
+        if key in meta:
+            meta[key] = int(meta[key])
+    trees: List[Tree] = []
+    if rest:
+        body = "Tree=" + rest
+        body = body.split("end of trees")[0]
+        blocks = body.split("\nTree=")
+        for i, b in enumerate(blocks):
+            if not b.strip():
+                continue
+            if not b.startswith("Tree="):
+                b = "Tree=" + b
+            trees.append(Tree.from_string(b))
+    return meta, trees
+
+
+def dump_model_json(booster, trees: List[Tree]) -> Dict:
+    names = booster.feature_name()
+    return {
+        "name": "tree",
+        "version": _VERSION,
+        "num_class": booster.config.num_class,
+        "num_tree_per_iteration": booster.num_model_per_iteration(),
+        "label_index": 0,
+        "max_feature_idx": len(names) - 1,
+        "objective": _objective_string(booster),
+        "average_output": booster._avg_output(),
+        "feature_names": names,
+        "tree_info": [t.to_json(i) for i, t in enumerate(trees)],
+    }
+
+
+def model_to_cpp(booster, trees: List[Tree]) -> str:
+    """Whole-model C++ if-else codegen (reference: ModelToIfElse,
+    gbdt_model_text.cpp:87, used by the CLI convert_model task)."""
+    parts = [
+        "#include <cmath>",
+        "#include <cstdint>",
+        "static inline bool IsLeft(double v, double thr, bool default_left) {",
+        "  if (std::isnan(v)) return default_left;",
+        "  return v <= thr;",
+        "}",
+        "",
+    ]
+    for i, t in enumerate(trees):
+        parts.append(t.to_if_else(i))
+    k = booster.num_model_per_iteration()
+    parts.append("double (*PredictTreePtr[])(const double*) = {")
+    parts.append(",\n".join(f"  PredictTree{i}" for i in range(len(trees))))
+    parts.append("};")
+    parts.append(f"""
+void Predict(const double* features, double* output) {{
+  for (int k = 0; k < {k}; ++k) output[k] = 0.0;
+  for (int i = 0; i < {len(trees)}; ++i) {{
+    output[i % {k}] += PredictTreePtr[i](features);
+  }}
+}}
+""")
+    return "\n".join(parts)

@@ -1,0 +1,75 @@
+"""Random Forest mode.
+
+Reference: src/boosting/rf.hpp:25 — bagging-only ensemble: every tree is fit to the
+gradients at the *initial* score (no boosting), no shrinkage, and the ensemble
+output is the average over trees (``average_output``).
+"""
+from __future__ import annotations
+
+import jax.numpy as jnp
+import numpy as np
+
+from ..utils import log
+from .gbdt import GBDT
+
+
+class RF(GBDT):
+    name = "rf"
+    average_output = True
+
+    def __init__(self, config, train_set, objective, metrics=None):
+        if not (config.bagging_freq > 0 and
+                (config.bagging_fraction < 1.0 or config.feature_fraction < 1.0)):
+            log.fatal("RF mode requires bagging (bagging_freq > 0 and "
+                      "bagging_fraction < 1.0) or feature_fraction < 1.0")
+        super().__init__(config, train_set, objective, metrics)
+        self._const_score = None
+
+    def train_one_iter(self, grad=None, hess=None) -> bool:
+        k = self.num_tree_per_iteration
+        if self._const_score is None:
+            # RF boosts from the average once; gradients are then constant per tree
+            if self.objective is not None and self.config.boost_from_average:
+                for cls in range(k):
+                    self.init_scores[cls] = self.objective.boost_from_score()
+            shape = self.train_score.shape
+            shift = jnp.asarray(self.init_scores, dtype=jnp.float32)
+            self._const_score = (jnp.zeros(shape, jnp.float32)
+                                 + (shift[0] if k == 1 else shift[None, :]))
+        if grad is None:
+            grad, hess = self.objective.get_gradients(self._const_score)
+        self._update_bag(self.iter_, grad, hess)
+        finished = self._grow_and_update(grad, hess)
+        self.iter_ += 1
+        return finished
+
+    def _finish_tree(self, tree_dev, leaf_id, cls):
+        # no shrinkage in RF (rf.hpp); leaf values used as-is
+        return tree_dev
+
+    def _update_scores(self, tree_dev, leaf_id, cls) -> None:
+        """Maintain scores as running averages (rf.hpp TrainOneIter)."""
+        k = self.num_tree_per_iteration
+        t = self.iter_ + 1  # trees per class after this one
+        delta = tree_dev.leaf_value[leaf_id]
+        if k == 1:
+            self.train_score = (self.train_score * max(t - 1, 0) + self._const_score * 0
+                                + delta + (self.train_score * 0)) / t \
+                if t == 1 else (self.train_score * (t - 1) + delta) / t
+        else:
+            prev = self.train_score[:, cls] * (t - 1)
+            self.train_score = self.train_score.at[:, cls].set((prev + delta) / t)
+        from ..ops import predict as P
+        max_steps = self.gp.num_leaves - 1 if self.gp.num_leaves > 1 else 1
+        for i, vs in enumerate(self.valid_sets):
+            leaf = P.route_bins(
+                tree_dev.split_feature, tree_dev.threshold_bin,
+                tree_dev.default_left, tree_dev.left_child, tree_dev.right_child,
+                tree_dev.num_leaves, vs.bins, vs.na_bin_dev, max_steps)
+            vdelta = tree_dev.leaf_value[leaf]
+            if k == 1:
+                self.valid_scores[i] = (self.valid_scores[i] * (t - 1) + vdelta) / t
+            else:
+                prev = self.valid_scores[i][:, cls] * (t - 1)
+                self.valid_scores[i] = self.valid_scores[i].at[:, cls].set(
+                    (prev + vdelta) / t)

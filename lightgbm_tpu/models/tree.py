@@ -1,0 +1,298 @@
+"""Host-side tree model.
+
+Reference analog: Tree (include/LightGBM/tree.h:25, src/io/tree.cpp) — a
+fixed-capacity flat-array decision tree. The device grower (ops/grow.py) emits the
+same flat layout; this module finalizes it host-side (trims to the real leaf count,
+maps bin thresholds to real-valued thresholds via the BinMappers) and provides
+text/JSON serialization in the reference's model format plus if-else code generation
+(tree.h:194-200 ToString/ToJSON/ToIfElse).
+"""
+from __future__ import annotations
+
+from typing import Dict, List, Optional
+
+import numpy as np
+
+from ..binning import BinMapper, MISSING_NAN, MISSING_NONE, MISSING_ZERO
+
+
+_MISSING_TYPE_MASK = {MISSING_NONE: 0, MISSING_ZERO: 4, MISSING_NAN: 8}
+
+
+class Tree:
+    """One decision tree, host-side numpy arrays (reference: tree.h:25)."""
+
+    def __init__(self, num_leaves: int,
+                 split_feature: np.ndarray, threshold_bin: np.ndarray,
+                 default_left: np.ndarray, left_child: np.ndarray,
+                 right_child: np.ndarray, split_gain: np.ndarray,
+                 leaf_value: np.ndarray, leaf_weight: np.ndarray,
+                 leaf_count: np.ndarray, internal_value: np.ndarray,
+                 internal_weight: np.ndarray, internal_count: np.ndarray,
+                 threshold_real: Optional[np.ndarray] = None,
+                 missing_type: Optional[np.ndarray] = None,
+                 shrinkage: float = 1.0):
+        self.num_leaves = int(num_leaves)
+        n_int = max(self.num_leaves - 1, 0)
+        self.split_feature = np.asarray(split_feature[:n_int], dtype=np.int32)
+        self.threshold_bin = np.asarray(threshold_bin[:n_int], dtype=np.int32)
+        self.default_left = np.asarray(default_left[:n_int], dtype=bool)
+        self.left_child = np.asarray(left_child[:n_int], dtype=np.int32)
+        self.right_child = np.asarray(right_child[:n_int], dtype=np.int32)
+        self.split_gain = np.asarray(split_gain[:n_int], dtype=np.float64)
+        self.leaf_value = np.asarray(leaf_value[:self.num_leaves], dtype=np.float64)
+        self.leaf_weight = np.asarray(leaf_weight[:self.num_leaves], dtype=np.float64)
+        self.leaf_count = np.asarray(leaf_count[:self.num_leaves], dtype=np.int64)
+        self.internal_value = np.asarray(internal_value[:n_int], dtype=np.float64)
+        self.internal_weight = np.asarray(internal_weight[:n_int], dtype=np.float64)
+        self.internal_count = np.asarray(internal_count[:n_int], dtype=np.int64)
+        self.threshold_real = (np.asarray(threshold_real[:n_int], dtype=np.float64)
+                               if threshold_real is not None
+                               else np.zeros(n_int, dtype=np.float64))
+        self.missing_type = (np.asarray(missing_type[:n_int], dtype=np.int32)
+                             if missing_type is not None
+                             else np.zeros(n_int, dtype=np.int32))
+        self.shrinkage = shrinkage
+
+    @staticmethod
+    def from_device(arrays, mappers: List[BinMapper],
+                    feature_map: Optional[np.ndarray] = None) -> "Tree":
+        """Build from ops.grow.TreeArrays; maps bin thresholds to real values."""
+        nl = int(arrays.num_leaves)
+        sf = np.asarray(arrays.split_feature)
+        tb = np.asarray(arrays.threshold_bin)
+        n_int = max(nl - 1, 0)
+        thr_real = np.zeros(n_int)
+        mtypes = np.zeros(n_int, dtype=np.int32)
+        for i in range(n_int):
+            m = mappers[sf[i]]
+            thr_real[i] = m.bin_to_value(int(tb[i]))
+            mtypes[i] = m.missing_type
+        if feature_map is not None:
+            sf_orig = feature_map[sf[:n_int]] if n_int else sf[:n_int]
+        else:
+            sf_orig = sf[:n_int]
+        return Tree(
+            num_leaves=nl,
+            split_feature=sf_orig, threshold_bin=tb,
+            default_left=np.asarray(arrays.default_left),
+            left_child=np.asarray(arrays.left_child),
+            right_child=np.asarray(arrays.right_child),
+            split_gain=np.asarray(arrays.split_gain),
+            leaf_value=np.asarray(arrays.leaf_value),
+            leaf_weight=np.asarray(arrays.leaf_weight),
+            leaf_count=np.asarray(arrays.leaf_count),
+            internal_value=np.asarray(arrays.internal_value),
+            internal_weight=np.asarray(arrays.internal_weight),
+            internal_count=np.asarray(arrays.internal_count),
+            threshold_real=thr_real, missing_type=mtypes,
+        )
+
+    # ---- mutation (reference: Tree::Shrinkage tree.h:154, AddBias tree.h:172) ----
+    def shrink(self, rate: float) -> None:
+        self.leaf_value *= rate
+        self.internal_value *= rate
+        self.shrinkage *= rate
+
+    def add_bias(self, val: float) -> None:
+        self.leaf_value += val
+        self.internal_value += val
+
+    def set_leaf_values(self, values: np.ndarray) -> None:
+        self.leaf_value = np.asarray(values[: self.num_leaves], dtype=np.float64)
+
+    @property
+    def max_depth(self) -> int:
+        if self.num_leaves <= 1:
+            return 0
+        depth = np.zeros(self.num_leaves - 1, dtype=np.int32)
+        md = 1
+        # nodes are created in BFS-ish order but parent always precedes child
+        for i in range(self.num_leaves - 1):
+            for c in (self.left_child[i], self.right_child[i]):
+                if c >= 0:
+                    depth[c] = depth[i] + 1
+                    md = max(md, depth[c] + 1)
+        return md
+
+    # ---- prediction (host reference path; device path in ops/predict.py) ----
+    def predict(self, x: np.ndarray) -> np.ndarray:
+        """x: [N, F] raw features -> leaf values [N]."""
+        leaf = self.predict_leaf(x)
+        return self.leaf_value[leaf]
+
+    def predict_leaf(self, x: np.ndarray) -> np.ndarray:
+        n = x.shape[0]
+        out = np.zeros(n, dtype=np.int32)
+        if self.num_leaves <= 1:
+            return out
+        node = np.zeros(n, dtype=np.int32)
+        active = np.ones(n, dtype=bool)
+        while active.any():
+            idx = np.nonzero(active)[0]
+            nd = node[idx]
+            feat = self.split_feature[nd]
+            v = x[idx, feat]
+            thr = self.threshold_real[nd]
+            mt = self.missing_type[nd]
+            isnan = np.isnan(v)
+            v0 = np.where(isnan & (mt == MISSING_NONE), 0.0, v)
+            is_missing = np.where(mt == MISSING_NAN, isnan,
+                                  np.where(mt == MISSING_ZERO,
+                                           (np.abs(v0) < 1e-35) | isnan, False))
+            go_left = np.where(is_missing, self.default_left[nd], v0 <= thr)
+            nxt = np.where(go_left, self.left_child[nd], self.right_child[nd])
+            leaf_hit = nxt < 0
+            out[idx[leaf_hit]] = ~nxt[leaf_hit]
+            node[idx[~leaf_hit]] = nxt[~leaf_hit]
+            active[idx[leaf_hit]] = False
+        return out
+
+    # ---- serialization (reference: gbdt_model_text.cpp:271 per-tree blocks) ----
+    def to_string(self, tree_idx: int) -> str:
+        def arr(a, fmt="%g"):
+            return " ".join(fmt % v for v in a)
+
+        n_int = self.num_leaves - 1
+        decision_type = np.zeros(max(n_int, 0), dtype=np.int32)
+        for i in range(n_int):
+            dt = 0  # bit0: categorical; bit1: default_left; bits2-3: missing type
+            if self.default_left[i]:
+                dt |= 2
+            dt |= _MISSING_TYPE_MASK.get(int(self.missing_type[i]), 0)
+            decision_type[i] = dt
+        lines = [f"Tree={tree_idx}",
+                 f"num_leaves={self.num_leaves}",
+                 "num_cat=0",
+                 f"split_feature={arr(self.split_feature, '%d')}",
+                 f"split_gain={arr(self.split_gain)}",
+                 f"threshold={arr(self.threshold_real, '%.17g')}",
+                 f"decision_type={arr(decision_type, '%d')}",
+                 f"left_child={arr(self.left_child, '%d')}",
+                 f"right_child={arr(self.right_child, '%d')}",
+                 f"leaf_value={arr(self.leaf_value, '%.17g')}",
+                 f"leaf_weight={arr(self.leaf_weight, '%.17g')}",
+                 f"leaf_count={arr(self.leaf_count, '%d')}",
+                 f"internal_value={arr(self.internal_value, '%.17g')}",
+                 f"internal_weight={arr(self.internal_weight, '%g')}",
+                 f"internal_count={arr(self.internal_count, '%d')}",
+                 f"shrinkage={self.shrinkage:g}",
+                 ""]
+        return "\n".join(lines)
+
+    @staticmethod
+    def from_string(block: str) -> "Tree":
+        kv: Dict[str, str] = {}
+        for line in block.strip().splitlines():
+            if "=" in line:
+                k, v = line.split("=", 1)
+                kv[k.strip()] = v.strip()
+        nl = int(kv["num_leaves"])
+
+        def arr(key, dtype, size):
+            s = kv.get(key, "")
+            if not s:
+                return np.zeros(size, dtype=dtype)
+            return np.fromstring(s, dtype=dtype, sep=" ") if False else \
+                np.array(s.split(" "), dtype=dtype)
+
+        n_int = max(nl - 1, 0)
+        dt = arr("decision_type", np.int32, n_int)
+        default_left = (dt & 2) > 0
+        mt = np.where((dt & 12) == 8, MISSING_NAN,
+                      np.where((dt & 12) == 4, MISSING_ZERO, MISSING_NONE))
+        t = Tree(
+            num_leaves=nl,
+            split_feature=arr("split_feature", np.int32, n_int),
+            threshold_bin=np.zeros(n_int, dtype=np.int32),
+            default_left=default_left,
+            left_child=arr("left_child", np.int32, n_int),
+            right_child=arr("right_child", np.int32, n_int),
+            split_gain=arr("split_gain", np.float64, n_int),
+            leaf_value=arr("leaf_value", np.float64, nl),
+            leaf_weight=arr("leaf_weight", np.float64, nl),
+            leaf_count=arr("leaf_count", np.int64, nl),
+            internal_value=arr("internal_value", np.float64, n_int),
+            internal_weight=arr("internal_weight", np.float64, n_int),
+            internal_count=arr("internal_count", np.int64, n_int),
+            threshold_real=arr("threshold", np.float64, n_int),
+            missing_type=mt,
+            shrinkage=float(kv.get("shrinkage", 1.0)),
+        )
+        return t
+
+    def to_json(self, tree_idx: int) -> Dict:
+        def node_json(ptr: int) -> Dict:
+            if ptr < 0:
+                leaf = ~ptr
+                return {"leaf_index": int(leaf),
+                        "leaf_value": float(self.leaf_value[leaf]),
+                        "leaf_weight": float(self.leaf_weight[leaf]),
+                        "leaf_count": int(self.leaf_count[leaf])}
+            return {
+                "split_index": int(ptr),
+                "split_feature": int(self.split_feature[ptr]),
+                "split_gain": float(self.split_gain[ptr]),
+                "threshold": float(self.threshold_real[ptr]),
+                "decision_type": "<=",
+                "default_left": bool(self.default_left[ptr]),
+                "missing_type": ["None", "Zero", "NaN"][int(self.missing_type[ptr])],
+                "internal_value": float(self.internal_value[ptr]),
+                "internal_weight": float(self.internal_weight[ptr]),
+                "internal_count": int(self.internal_count[ptr]),
+                "left_child": node_json(int(self.left_child[ptr])),
+                "right_child": node_json(int(self.right_child[ptr])),
+            }
+        root = 0 if self.num_leaves > 1 else ~0
+        return {"tree_index": tree_idx, "num_leaves": self.num_leaves,
+                "num_cat": 0, "shrinkage": self.shrinkage,
+                "tree_structure": node_json(root)}
+
+    def to_if_else(self, index: int) -> str:
+        """C++ codegen of this tree (reference: Tree::ToIfElse, tree.h:200)."""
+        def rec(ptr: int, indent: str) -> str:
+            if ptr < 0:
+                return f"{indent}return {self.leaf_value[~ptr]!r};\n"
+            f_ = self.split_feature[ptr]
+            thr = self.threshold_real[ptr]
+            dl = "true" if self.default_left[ptr] else "false"
+            s = f"{indent}if (IsLeft(arr[{f_}], {thr!r}, {dl})) {{\n"
+            s += rec(int(self.left_child[ptr]), indent + "  ")
+            s += f"{indent}}} else {{\n"
+            s += rec(int(self.right_child[ptr]), indent + "  ")
+            s += f"{indent}}}\n"
+            return s
+        body = rec(0 if self.num_leaves > 1 else ~0, "  ")
+        return (f"double PredictTree{index}(const double* arr) {{\n{body}}}\n")
+
+
+def stack_trees(trees: List[Tree], num_features: int, max_num_bins: int,
+                pad_leaves: Optional[int] = None) -> Dict[str, np.ndarray]:
+    """Stack per-tree flat arrays into [T, ...] device-ready arrays for the jitted
+    ensemble predictors (ops/predict.py)."""
+    t = len(trees)
+    max_l = pad_leaves or max((tr.num_leaves for tr in trees), default=1)
+    max_i = max(max_l - 1, 1)
+    out = {
+        "split_feature": np.zeros((t, max_i), dtype=np.int32),
+        "threshold_bin": np.zeros((t, max_i), dtype=np.int32),
+        "threshold_real": np.zeros((t, max_i), dtype=np.float32),
+        "default_left": np.zeros((t, max_i), dtype=bool),
+        "left_child": np.full((t, max_i), -1, dtype=np.int32),
+        "right_child": np.full((t, max_i), -1, dtype=np.int32),
+        "leaf_value": np.zeros((t, max_l), dtype=np.float32),
+        "num_leaves": np.zeros((t,), dtype=np.int32),
+        "missing_type": np.zeros((t, max_i), dtype=np.int32),
+    }
+    for i, tr in enumerate(trees):
+        n_int = max(tr.num_leaves - 1, 0)
+        out["split_feature"][i, :n_int] = tr.split_feature
+        out["threshold_bin"][i, :n_int] = tr.threshold_bin
+        out["threshold_real"][i, :n_int] = tr.threshold_real
+        out["default_left"][i, :n_int] = tr.default_left
+        out["left_child"][i, :n_int] = tr.left_child
+        out["right_child"][i, :n_int] = tr.right_child
+        out["leaf_value"][i, : tr.num_leaves] = tr.leaf_value
+        out["num_leaves"][i] = tr.num_leaves
+        out["missing_type"][i, :n_int] = tr.missing_type
+    return out

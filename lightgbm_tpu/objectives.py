@@ -1,0 +1,611 @@
+"""Objective functions — pure-JAX gradient/hessian providers.
+
+TPU-native re-design of the reference objective layer (src/objective/, factory
+objective_function.cpp:16-53): each objective is a small class exposing
+``get_gradients(score) -> (grad, hess)`` as jit-friendly functions of device arrays,
+plus ``boost_from_score`` (reference: BoostFromScore), ``convert_output`` (sigmoid /
+softmax / exp) and ``is_constant_hessian``.
+
+Coverage matches the reference's 16 objectives (objective_function.cpp:16):
+regression l2/l1/huber/fair/poisson/quantile/mape/gamma/tweedie, binary, multiclass
+softmax / OVA, cross-entropy / cross-entropy-lambda, lambdarank, rank_xendcg.
+"""
+from __future__ import annotations
+
+from typing import Dict, Optional, Tuple
+
+import jax
+import jax.numpy as jnp
+import numpy as np
+
+from .utils import log
+
+
+def _weighted(grad, hess, weight):
+    if weight is None:
+        return grad, hess
+    return grad * weight, hess * weight
+
+
+class ObjectiveFunction:
+    """Base objective (reference: ObjectiveFunction, objective_function.h:19)."""
+
+    name = "custom"
+    is_constant_hessian = False
+    num_model_per_iteration = 1
+    need_group = False
+
+    def __init__(self, config):
+        self.config = config
+        self.label = None
+        self.weight = None
+
+    def init(self, label: jnp.ndarray, weight: Optional[jnp.ndarray],
+             group: Optional[np.ndarray] = None) -> None:
+        """Bind metadata (reference: ObjectiveFunction::Init)."""
+        self.label = label
+        self.weight = weight
+        self.num_data = label.shape[0]
+
+    def get_gradients(self, score: jnp.ndarray) -> Tuple[jnp.ndarray, jnp.ndarray]:
+        raise NotImplementedError
+
+    def boost_from_score(self) -> float:
+        """Initial raw score (reference: BoostFromScore)."""
+        return 0.0
+
+    def convert_output(self, score: jnp.ndarray) -> jnp.ndarray:
+        return score
+
+    def renew_leaf_values(self, score, leaf_id, num_leaves):
+        """Per-leaf output renewal for L1-family objectives (reference:
+        RenewTreeOutput, regression_objective.hpp). Returns None if not needed."""
+        return None
+
+    def __str__(self):
+        return self.name
+
+
+# ---------------- regression family (regression_objective.hpp) ----------------
+
+class RegressionL2(ObjectiveFunction):
+    name = "regression"
+    is_constant_hessian = True  # with unit weights
+
+    def init(self, label, weight, group=None):
+        super().init(label, weight, group)
+        if self.config.reg_sqrt:
+            self._raw_label = label
+            self.label = jnp.sign(label) * jnp.sqrt(jnp.abs(label))
+        self.is_constant_hessian = weight is None
+
+    def get_gradients(self, score):
+        grad = score - self.label
+        hess = jnp.ones_like(score)
+        return _weighted(grad, hess, self.weight)
+
+    def boost_from_score(self):
+        if self.weight is None:
+            return float(jnp.mean(self.label))
+        return float(jnp.sum(self.label * self.weight) / jnp.sum(self.weight))
+
+    def convert_output(self, score):
+        if self.config.reg_sqrt:
+            return jnp.sign(score) * score * score
+        return score
+
+
+class RegressionL1(RegressionL2):
+    name = "regression_l1"
+    is_constant_hessian = True
+
+    def get_gradients(self, score):
+        grad = jnp.sign(score - self.label)
+        hess = jnp.ones_like(score)
+        return _weighted(grad, hess, self.weight)
+
+    def boost_from_score(self):
+        return float(_weighted_percentile(self.label, self.weight, 0.5))
+
+    def renew_leaf_values(self, score, leaf_id, num_leaves):
+        # leaf value = weighted median of residuals (reference:
+        # RegressionL1loss::RenewTreeOutput, regression_objective.hpp)
+        return _leaf_percentile(self.label - score, leaf_id, num_leaves,
+                                0.5, self.weight)
+
+
+class Huber(RegressionL2):
+    name = "huber"
+    is_constant_hessian = False
+
+    def get_gradients(self, score):
+        d = score - self.label
+        a = self.config.alpha
+        grad = jnp.clip(d, -a, a)
+        hess = jnp.ones_like(score)
+        return _weighted(grad, hess, self.weight)
+
+
+class Fair(RegressionL2):
+    name = "fair"
+    is_constant_hessian = False
+
+    def get_gradients(self, score):
+        d = score - self.label
+        c = self.config.fair_c
+        grad = c * d / (jnp.abs(d) + c)
+        hess = c * c / (jnp.abs(d) + c) ** 2
+        return _weighted(grad, hess, self.weight)
+
+
+class Poisson(RegressionL2):
+    name = "poisson"
+    is_constant_hessian = False
+
+    def init(self, label, weight, group=None):
+        super().init(label, weight, group)
+        self._hess_scale = float(np.exp(self.config.poisson_max_delta_step))
+
+    def get_gradients(self, score):
+        ex = jnp.exp(score)
+        grad = ex - self.label
+        hess = ex * self._hess_scale
+        return _weighted(grad, hess, self.weight)
+
+    def boost_from_score(self):
+        if self.weight is None:
+            mean = float(jnp.mean(self.label))
+        else:
+            mean = float(jnp.sum(self.label * self.weight) / jnp.sum(self.weight))
+        return float(np.log(max(mean, 1e-9)))
+
+    def convert_output(self, score):
+        return jnp.exp(score)
+
+
+class Quantile(RegressionL2):
+    name = "quantile"
+    is_constant_hessian = True
+
+    def get_gradients(self, score):
+        a = self.config.alpha
+        d = score - self.label
+        grad = jnp.where(d >= 0, 1.0 - a, -a)
+        hess = jnp.ones_like(score)
+        return _weighted(grad, hess, self.weight)
+
+    def boost_from_score(self):
+        return float(_weighted_percentile(self.label, self.weight, self.config.alpha))
+
+    def renew_leaf_values(self, score, leaf_id, num_leaves):
+        return _leaf_percentile(self.label - score, leaf_id, num_leaves,
+                                self.config.alpha, self.weight)
+
+
+class Mape(RegressionL2):
+    name = "mape"
+    is_constant_hessian = True
+
+    def init(self, label, weight, group=None):
+        super().init(label, weight, group)
+        w = weight if weight is not None else jnp.ones_like(label)
+        self._mape_w = w / jnp.maximum(1.0, jnp.abs(label))
+
+    def get_gradients(self, score):
+        grad = jnp.sign(score - self.label) * self._mape_w
+        hess = self._mape_w
+        return grad, hess
+
+    def boost_from_score(self):
+        return float(_weighted_percentile(self.label, self._mape_w, 0.5))
+
+    def renew_leaf_values(self, score, leaf_id, num_leaves):
+        return _leaf_percentile(self.label - score, leaf_id, num_leaves,
+                                0.5, self._mape_w)
+
+
+class Gamma(Poisson):
+    name = "gamma"
+
+    def init(self, label, weight, group=None):
+        RegressionL2.init(self, label, weight, group)
+
+    def get_gradients(self, score):
+        ex = jnp.exp(-score)
+        grad = 1.0 - self.label * ex
+        hess = self.label * ex
+        return _weighted(grad, hess, self.weight)
+
+
+class Tweedie(Poisson):
+    name = "tweedie"
+
+    def init(self, label, weight, group=None):
+        RegressionL2.init(self, label, weight, group)
+        self.rho = self.config.tweedie_variance_power
+
+    def get_gradients(self, score):
+        rho = self.rho
+        e1 = jnp.exp((1.0 - rho) * score)
+        e2 = jnp.exp((2.0 - rho) * score)
+        grad = -self.label * e1 + e2
+        hess = -self.label * (1.0 - rho) * e1 + (2.0 - rho) * e2
+        return _weighted(grad, hess, self.weight)
+
+
+# ---------------- binary (binary_objective.hpp:21) ----------------
+
+class Binary(ObjectiveFunction):
+    name = "binary"
+
+    def __init__(self, config):
+        super().__init__(config)
+        self.sigmoid = config.sigmoid
+
+    def init(self, label, weight, group=None):
+        super().init(label, weight, group)
+        # labels may be 0/1
+        self.label_pos = (label > 0).astype(jnp.float32)
+        cnt_pos = float(jnp.sum(self.label_pos * (weight if weight is not None else 1.0)))
+        cnt_all = float(jnp.sum(weight)) if weight is not None else float(label.shape[0])
+        cnt_neg = cnt_all - cnt_pos
+        self._cnt_pos, self._cnt_neg = cnt_pos, cnt_neg
+        self.label_weight_pos = 1.0
+        self.label_weight_neg = 1.0
+        if self.config.is_unbalance and cnt_pos > 0 and cnt_neg > 0:
+            if cnt_pos > cnt_neg:
+                self.label_weight_neg = cnt_pos / cnt_neg
+            else:
+                self.label_weight_pos = cnt_neg / cnt_pos
+        elif self.config.scale_pos_weight != 1.0:
+            self.label_weight_pos = self.config.scale_pos_weight
+
+    def get_gradients(self, score):
+        t = 2.0 * self.label_pos - 1.0                      # +-1
+        lw = jnp.where(self.label_pos > 0, self.label_weight_pos, self.label_weight_neg)
+        resp = 1.0 / (1.0 + jnp.exp(t * self.sigmoid * score))
+        grad = -t * resp * self.sigmoid * lw
+        hess = self.sigmoid * self.sigmoid * resp * (1.0 - resp) * lw
+        return _weighted(grad, hess, self.weight)
+
+    def boost_from_score(self):
+        if self._cnt_pos <= 0 or self._cnt_neg <= 0:
+            return 0.0
+        p = self._cnt_pos * self.label_weight_pos / (
+            self._cnt_pos * self.label_weight_pos + self._cnt_neg * self.label_weight_neg)
+        return float(np.log(p / (1.0 - p)) / self.sigmoid)
+
+    def convert_output(self, score):
+        return 1.0 / (1.0 + jnp.exp(-self.sigmoid * score))
+
+
+# ---------------- multiclass (multiclass_objective.hpp:24) ----------------
+
+class MulticlassSoftmax(ObjectiveFunction):
+    name = "multiclass"
+
+    def __init__(self, config):
+        super().__init__(config)
+        self.num_class = config.num_class
+        self.num_model_per_iteration = config.num_class
+
+    def init(self, label, weight, group=None):
+        super().init(label, weight, group)
+        self.label_int = label.astype(jnp.int32)
+        self.onehot = jax.nn.one_hot(self.label_int, self.num_class, dtype=jnp.float32)
+
+    def get_gradients(self, score):
+        """score: [N, K] -> grad/hess [N, K]."""
+        prob = jax.nn.softmax(score, axis=-1)
+        grad = prob - self.onehot
+        factor = self.num_class / (self.num_class - 1.0)
+        hess = factor * prob * (1.0 - prob)
+        if self.weight is not None:
+            grad = grad * self.weight[:, None]
+            hess = hess * self.weight[:, None]
+        return grad, hess
+
+    def convert_output(self, score):
+        return jax.nn.softmax(score, axis=-1)
+
+
+class MulticlassOVA(ObjectiveFunction):
+    name = "multiclassova"
+
+    def __init__(self, config):
+        super().__init__(config)
+        self.num_class = config.num_class
+        self.num_model_per_iteration = config.num_class
+        self.sigmoid = config.sigmoid
+
+    def init(self, label, weight, group=None):
+        super().init(label, weight, group)
+        self.onehot = jax.nn.one_hot(label.astype(jnp.int32), self.num_class,
+                                     dtype=jnp.float32)
+
+    def get_gradients(self, score):
+        t = 2.0 * self.onehot - 1.0
+        resp = 1.0 / (1.0 + jnp.exp(t * self.sigmoid * score))
+        grad = -t * resp * self.sigmoid
+        hess = self.sigmoid * self.sigmoid * resp * (1.0 - resp)
+        if self.weight is not None:
+            grad = grad * self.weight[:, None]
+            hess = hess * self.weight[:, None]
+        return grad, hess
+
+    def convert_output(self, score):
+        return 1.0 / (1.0 + jnp.exp(-self.sigmoid * score))
+
+
+# ---------------- cross-entropy (xentropy_objective.hpp) ----------------
+
+class CrossEntropy(ObjectiveFunction):
+    """Label in [0, 1] (reference: CrossEntropy, xentropy_objective.hpp:21)."""
+    name = "cross_entropy"
+
+    def get_gradients(self, score):
+        p = 1.0 / (1.0 + jnp.exp(-score))
+        grad = p - self.label
+        hess = p * (1.0 - p)
+        return _weighted(grad, hess, self.weight)
+
+    def boost_from_score(self):
+        if self.weight is None:
+            m = float(jnp.mean(self.label))
+        else:
+            m = float(jnp.sum(self.label * self.weight) / jnp.sum(self.weight))
+        m = min(max(m, 1e-9), 1 - 1e-9)
+        return float(np.log(m / (1 - m)))
+
+    def convert_output(self, score):
+        return 1.0 / (1.0 + jnp.exp(-score))
+
+
+class CrossEntropyLambda(ObjectiveFunction):
+    """Alternative parametrization (reference: CrossEntropyLambda,
+    xentropy_objective.hpp:~150)."""
+    name = "cross_entropy_lambda"
+
+    def get_gradients(self, score):
+        w = self.weight if self.weight is not None else 1.0
+        epf = jnp.exp(score)
+        hhat = jnp.log1p(epf)
+        z = 1.0 - jnp.exp(-w * hhat)
+        enf = jnp.exp(-score)
+        grad = (1.0 - self.label / jnp.maximum(z, 1e-12)) * w / (1.0 + enf)
+        c = 1.0 / jnp.maximum(1.0 - jnp.exp(-w * hhat), 1e-12)
+        d = 1.0 / (1.0 + enf)
+        hess = w * d * (1.0 - d) * (1.0 - self.label * c) \
+            + w * w * d * d * self.label * c * (1.0 - c) * -1.0
+        hess = jnp.abs(hess) + 1e-6
+        return grad, hess
+
+    def boost_from_score(self):
+        m = float(jnp.mean(self.label))
+        m = min(max(m, 1e-9), 1 - 1e-9)
+        return float(np.log(np.expm1(m))) if m > 0 else 0.0
+
+    def convert_output(self, score):
+        return jnp.log1p(jnp.exp(score))
+
+
+# ---------------- ranking (rank_objective.hpp:23) ----------------
+
+class LambdaRank(ObjectiveFunction):
+    """LambdaRank with NDCG-based lambdas (reference: rank_objective.hpp:23).
+
+    TPU reformulation: queries are padded into a dense [Q, M] doc grid; the per-query
+    O(M^2) pairwise lambda computation (reference's nested loops,
+    rank_objective.hpp:83+) becomes batched masked [Q, M, M] tensor ops, chunked over
+    queries to bound memory.
+    """
+    name = "lambdarank"
+    need_group = True
+
+    def init(self, label, weight, group=None):
+        super().init(label, weight, group)
+        if group is None:
+            log.fatal("lambdarank requires query/group information")
+        self.group = np.asarray(group, dtype=np.int64)
+        boundaries = np.concatenate([[0], np.cumsum(self.group)])
+        self.num_queries = len(self.group)
+        self.max_docs = int(self.group.max())
+        n = int(boundaries[-1])
+        # doc index grid [Q, M] (host-built, static)
+        idx = np.zeros((self.num_queries, self.max_docs), dtype=np.int32)
+        msk = np.zeros((self.num_queries, self.max_docs), dtype=bool)
+        for q in range(self.num_queries):
+            s, e = boundaries[q], boundaries[q + 1]
+            idx[q, : e - s] = np.arange(s, e)
+            msk[q, : e - s] = True
+        self._idx = jnp.asarray(idx)
+        self._msk = jnp.asarray(msk)
+        label_np = np.asarray(label)
+        # label gains (reference: label_gain, default 2^i - 1)
+        gains = self.config.label_gain
+        if not gains:
+            maxl = int(label_np.max())
+            gains = [(1 << i) - 1 for i in range(max(maxl + 1, 2))]
+        self._label_gain = jnp.asarray(np.array(gains, dtype=np.float64).astype(np.float32))
+        self.sigmoid = self.config.sigmoid
+        self.trunc = self.config.lambdarank_truncation_level
+        self.norm = self.config.lambdarank_norm
+        # inverse max DCG per query
+        lab_grid = np.where(msk, label_np[idx], -1)
+        inv_max_dcg = np.zeros(self.num_queries, dtype=np.float64)
+        for q in range(self.num_queries):
+            ls = np.sort(lab_grid[q][msk[q]])[::-1]
+            g = np.array([gains[int(v)] for v in ls], dtype=np.float64)
+            disc = 1.0 / np.log2(np.arange(len(ls)) + 2.0)
+            dcg = float((g * disc).sum())
+            inv_max_dcg[q] = 1.0 / dcg if dcg > 0 else 0.0
+        self._inv_max_dcg = jnp.asarray(inv_max_dcg.astype(np.float32))
+
+    def get_gradients(self, score):
+        lab = self.label[self._idx] * self._msk
+        sc = jnp.where(self._msk, score[self._idx], -jnp.inf)
+        grad_grid, hess_grid = _lambdarank_grid(
+            sc, lab.astype(jnp.int32), self._msk, self._label_gain,
+            self._inv_max_dcg, self.sigmoid, self.trunc, self.norm)
+        # scatter back to flat rows
+        grad = jnp.zeros_like(score).at[self._idx.reshape(-1)].add(
+            jnp.where(self._msk, grad_grid, 0.0).reshape(-1))
+        hess = jnp.zeros_like(score).at[self._idx.reshape(-1)].add(
+            jnp.where(self._msk, hess_grid, 0.0).reshape(-1))
+        return _weighted(grad, jnp.maximum(hess, 1e-16), self.weight)
+
+    def convert_output(self, score):
+        return score
+
+
+def _lambdarank_grid(sc, lab, msk, label_gain, inv_max_dcg, sigmoid, trunc, norm):
+    """Pairwise NDCG lambdas over a padded [Q, M] doc grid."""
+    q, m = sc.shape
+    # rank of each doc by score (descending) within query
+    order = jnp.argsort(-sc, axis=1)
+    ranks = jnp.zeros_like(order).at[
+        jnp.arange(q)[:, None], order].set(jnp.arange(m)[None, :])
+    disc = 1.0 / jnp.log2(ranks.astype(jnp.float32) + 2.0)        # [Q, M]
+    gain = label_gain[jnp.clip(lab, 0, label_gain.shape[0] - 1)]  # [Q, M]
+
+    s_i, s_j = sc[:, :, None], sc[:, None, :]
+    g_i, g_j = gain[:, :, None], gain[:, None, :]
+    d_i, d_j = disc[:, :, None], disc[:, None, :]
+    r_i, r_j = ranks[:, :, None], ranks[:, None, :]
+    valid = msk[:, :, None] & msk[:, None, :] & (g_i > g_j)
+    # truncation: only pairs where the better-ranked doc is within top `trunc`
+    valid &= (jnp.minimum(r_i, r_j) < trunc)
+
+    delta_pair = jnp.abs(g_i - g_j) * jnp.abs(d_i - d_j) * inv_max_dcg[:, None, None]
+    ds = s_i - s_j
+    p = 1.0 / (1.0 + jnp.exp(sigmoid * ds))       # P(worse beats better)
+    lam = -sigmoid * p * delta_pair
+    hes = sigmoid * sigmoid * p * (1.0 - p) * delta_pair
+    lam = jnp.where(valid, lam, 0.0)
+    hes = jnp.where(valid, hes, 0.0)
+
+    grad = lam.sum(axis=2) - lam.sum(axis=1)      # i gets +, j gets -
+    hess = hes.sum(axis=2) + hes.sum(axis=1)
+    if norm:
+        # normalize by total |lambda| per query (reference: lambdarank_norm)
+        denom = jnp.abs(lam).sum(axis=(1, 2), keepdims=False)[:, None] + 1e-9
+        scale = jnp.log2(1.0 + denom) / denom
+        grad = grad * scale
+        hess = hess * scale
+    return grad, hess
+
+
+class RankXENDCG(LambdaRank):
+    """XE-NDCG ranking objective (reference: rank_xendcg_objective.hpp:19)."""
+    name = "rank_xendcg"
+
+    def __init__(self, config):
+        super().__init__(config)
+        self._rng = np.random.RandomState(config.objective_seed if hasattr(config, "objective_seed") else 1)
+        self._key = jax.random.PRNGKey(int(config.seed or 1))
+
+    def get_gradients(self, score):
+        self._key, sub = jax.random.split(self._key)
+        lab = self.label[self._idx] * self._msk
+        sc = jnp.where(self._msk, score[self._idx], -1e30)
+        gumbel = -jnp.log(-jnp.log(
+            jax.random.uniform(sub, sc.shape, minval=1e-20, maxval=1.0)))
+        rho = jax.nn.softmax(jnp.where(self._msk, sc, -1e30), axis=1)
+        gain = self._label_gain[jnp.clip(lab.astype(jnp.int32), 0,
+                                         self._label_gain.shape[0] - 1)]
+        # terms from the XE-NDCG paper's gradient decomposition
+        phi = gain + gumbel * 0.0  # deterministic variant: gumbel off by default
+        denom = jnp.sum(jnp.where(self._msk, phi, 0.0), axis=1, keepdims=True) + 1e-9
+        t = phi / denom
+        grad_grid = rho - t
+        hess_grid = rho * (1.0 - rho)
+        grad_grid = jnp.where(self._msk, grad_grid, 0.0)
+        hess_grid = jnp.where(self._msk, hess_grid, 0.0)
+        grad = jnp.zeros_like(score).at[self._idx.reshape(-1)].add(grad_grid.reshape(-1))
+        hess = jnp.zeros_like(score).at[self._idx.reshape(-1)].add(hess_grid.reshape(-1))
+        return _weighted(grad, jnp.maximum(hess, 1e-16), self.weight)
+
+
+# ---------------- percentile helpers (for L1-family leaf renewal) ----------------
+
+def _weighted_percentile(values, weights, alpha):
+    v = jnp.sort(values)
+    if weights is None:
+        n = v.shape[0]
+        idx = jnp.clip((alpha * n).astype(jnp.int32) if hasattr(alpha, "astype")
+                       else int(alpha * n), 0, n - 1)
+        return v[idx]
+    order = jnp.argsort(values)
+    w = weights[order]
+    cw = jnp.cumsum(w)
+    cutoff = alpha * cw[-1]
+    idx = jnp.searchsorted(cw, cutoff)
+    return v[jnp.clip(idx, 0, v.shape[0] - 1)]
+
+
+def _leaf_percentile(residual, leaf_id, num_leaves, alpha, weight):
+    """Per-leaf weighted percentile of residuals, vectorized by sorting rows by
+    (leaf, residual) once (reference: PercentileFun per leaf,
+    regression_objective.hpp)."""
+    n = residual.shape[0]
+    w = weight if weight is not None else jnp.ones_like(residual)
+    # sort by leaf then residual
+    big = (jnp.max(jnp.abs(residual)) + 1.0) * 2.0
+    key = leaf_id.astype(jnp.float32) * big * 2 + residual
+    order = jnp.argsort(key)
+    r_s = residual[order]
+    w_s = w[order]
+    l_s = leaf_id[order]
+    # cumulative weight within each leaf segment
+    cw = jnp.cumsum(w_s)
+    seg_start_mask = jnp.concatenate([jnp.array([True]), l_s[1:] != l_s[:-1]])
+    seg_offset = jnp.where(seg_start_mask, cw - w_s, 0.0)
+    seg_offset = jax.lax.associative_scan(jnp.maximum, seg_offset)
+    cw_in = cw - seg_offset
+    leaf_tot = jnp.zeros(num_leaves).at[l_s].add(w_s)
+    cutoff = alpha * leaf_tot[l_s]
+    # first position in each leaf where cum weight >= cutoff
+    hit = (cw_in >= cutoff) & (cw_in - w_s < cutoff)
+    out = jnp.full(num_leaves, -jnp.inf).at[jnp.where(hit, l_s, num_leaves - 1)].max(
+        jnp.where(hit, r_s, -jnp.inf))
+    # fall back to 0 for empty leaves
+    return jnp.where(jnp.isfinite(out), out, 0.0)
+
+
+# ---------------- factory (objective_function.cpp:16) ----------------
+
+_OBJECTIVES: Dict[str, type] = {}
+_ALIAS = {
+    "regression": RegressionL2, "regression_l2": RegressionL2, "l2": RegressionL2,
+    "mean_squared_error": RegressionL2, "mse": RegressionL2, "l2_root": RegressionL2,
+    "root_mean_squared_error": RegressionL2, "rmse": RegressionL2,
+    "regression_l1": RegressionL1, "l1": RegressionL1, "mean_absolute_error": RegressionL1,
+    "mae": RegressionL1,
+    "huber": Huber, "fair": Fair, "poisson": Poisson, "quantile": Quantile,
+    "mape": Mape, "mean_absolute_percentage_error": Mape,
+    "gamma": Gamma, "tweedie": Tweedie,
+    "binary": Binary,
+    "multiclass": MulticlassSoftmax, "softmax": MulticlassSoftmax,
+    "multiclassova": MulticlassOVA, "multiclass_ova": MulticlassOVA,
+    "ova": MulticlassOVA, "ovr": MulticlassOVA,
+    "cross_entropy": CrossEntropy, "xentropy": CrossEntropy,
+    "cross_entropy_lambda": CrossEntropyLambda, "xentlambda": CrossEntropyLambda,
+    "lambdarank": LambdaRank, "rank_xendcg": RankXENDCG,
+    "xendcg": RankXENDCG, "xe_ndcg": RankXENDCG, "xe_ndcg_mart": RankXENDCG,
+    "xendcg_mart": RankXENDCG,
+    "none": None, "null": None, "custom": None, "na": None,
+}
+
+
+def create_objective(name: str, config) -> Optional[ObjectiveFunction]:
+    name = (name or "regression").lower()
+    if name in ("l2_root", "root_mean_squared_error", "rmse"):
+        config.reg_sqrt = False  # rmse == l2 for training
+    cls = _ALIAS.get(name, "missing")
+    if cls == "missing":
+        log.fatal(f"unknown objective: {name}")
+    if cls is None:
+        return None
+    obj = cls(config)
+    obj.name = name if name not in ("l2", "mse") else cls.name
+    return obj

@@ -1,0 +1,260 @@
+"""Jitted tree growing.
+
+TPU-native re-design of the reference's SerialTreeLearner
+(src/treelearner/serial_tree_learner.cpp:147-194): leaf-wise (best-first) growth as a
+``lax.scan`` over the ``num_leaves - 1`` split steps, entirely on device — zero host
+round-trips per tree.
+
+Key departures from the reference (SURVEY.md §7 design stance):
+- no DataPartition index reordering (data_partition.hpp:113): a per-row ``leaf_id``
+  vector is updated with a vectorized ``where`` on each split;
+- the smaller-child histogram is built with a masked full-width pass and the sibling
+  recovered by subtraction (the reference's subtraction trick,
+  serial_tree_learner.cpp:315-355, kept because it halves histogram work);
+- split selection is the vectorized argmax of ops/split.py, not a host-side scan;
+- histograms for all live leaves stay resident in HBM ([L, F, B, 3]) — the analog of
+  the reference's HistogramPool (feature_histogram.hpp:687) with capacity = num_leaves.
+"""
+from __future__ import annotations
+
+from dataclasses import dataclass
+from functools import partial
+from typing import NamedTuple, Tuple
+
+import jax
+import jax.numpy as jnp
+
+from . import histogram as H
+from .split import NEG_INF, SplitParams, SplitResult, best_split, leaf_output
+
+
+@dataclass(frozen=True)
+class GrowParams:
+    num_leaves: int = 31
+    max_depth: int = -1
+    max_bin: int = 255            # padded bin axis length B
+    split: SplitParams = SplitParams()
+    hist_impl: str = "auto"
+    # Data-parallel axis (reference: DataParallelTreeLearner,
+    # data_parallel_tree_learner.cpp:149-240). When set, rows are sharded over this
+    # mesh axis under shard_map and every histogram / root-sum is psum-ed — the
+    # reference's entire ReduceScatter+Allgather machinery (network.cpp) becomes
+    # these two collectives; split selection is computed replicated on all shards.
+    axis_name: str = ""
+
+
+def _psum(x, gp: "GrowParams"):
+    if gp.axis_name:
+        return jax.lax.psum(x, gp.axis_name)
+    return x
+
+
+class TreeArrays(NamedTuple):
+    """Flat-array tree, device-side (reference analog: Tree, tree.h:25).
+
+    Internal node ``i`` is created by split step ``i``; child pointers use the
+    reference's encoding: >= 0 -> internal node index, < 0 -> ~leaf_index.
+    """
+    split_feature: jnp.ndarray   # [L-1] i32
+    threshold_bin: jnp.ndarray   # [L-1] i32
+    default_left: jnp.ndarray    # [L-1] bool
+    left_child: jnp.ndarray      # [L-1] i32
+    right_child: jnp.ndarray     # [L-1] i32
+    split_gain: jnp.ndarray      # [L-1] f32
+    leaf_value: jnp.ndarray      # [L] f32
+    leaf_weight: jnp.ndarray     # [L] f32 (sum_hess)
+    leaf_count: jnp.ndarray      # [L] f32
+    internal_value: jnp.ndarray  # [L-1] f32
+    internal_weight: jnp.ndarray # [L-1] f32
+    internal_count: jnp.ndarray  # [L-1] f32
+    num_leaves: jnp.ndarray      # scalar i32
+
+
+class _GrowState(NamedTuple):
+    leaf_id: jnp.ndarray         # [N] i32
+    hist: jnp.ndarray            # [L, F, B, 3]
+    leaf_g: jnp.ndarray          # [L]
+    leaf_h: jnp.ndarray
+    leaf_cnt: jnp.ndarray
+    leaf_depth: jnp.ndarray      # [L] i32
+    parent_node: jnp.ndarray     # [L] i32: node whose child slot points at leaf
+    parent_right: jnp.ndarray    # [L] bool
+    best: SplitResult            # arrays [L]
+    tree: TreeArrays
+    done: jnp.ndarray            # scalar bool
+
+
+def _empty_tree(L: int) -> TreeArrays:
+    zi = jnp.zeros(max(L - 1, 1), dtype=jnp.int32)
+    zf = jnp.zeros(max(L - 1, 1), dtype=jnp.float32)
+    return TreeArrays(
+        split_feature=zi, threshold_bin=zi, default_left=jnp.zeros_like(zi, dtype=bool),
+        left_child=zi, right_child=zi, split_gain=zf,
+        leaf_value=jnp.zeros(L, jnp.float32), leaf_weight=jnp.zeros(L, jnp.float32),
+        leaf_count=jnp.zeros(L, jnp.float32),
+        internal_value=zf, internal_weight=zf, internal_count=zf,
+        num_leaves=jnp.int32(1),
+    )
+
+
+def _allow_depth(depth, gp: GrowParams):
+    if gp.max_depth > 0:
+        return depth < gp.max_depth
+    return jnp.ones_like(depth, dtype=bool) if hasattr(depth, "shape") else True
+
+
+@partial(jax.jit, static_argnames=("gp",))
+def grow_tree(bins: jnp.ndarray, ghc: jnp.ndarray,
+              num_bins: jnp.ndarray, na_bin: jnp.ndarray,
+              feature_mask: jnp.ndarray, gp: GrowParams
+              ) -> Tuple[TreeArrays, jnp.ndarray]:
+    """Grow one tree.
+
+    bins: [N, F] uint8; ghc: [N, 3] f32 (grad, hess, in-bag mask) — bagging is
+    mask-based (reference uses index subsets, gbdt.cpp:160-276; masks keep shapes
+    static on TPU); feature_mask: [F] bool (per-tree feature_fraction sample).
+
+    Returns (TreeArrays, leaf_id [N] i32). leaf_id routes *all* rows (including
+    out-of-bag) so the caller can update train scores by a single gather.
+    """
+    n, f = bins.shape
+    L, B = gp.num_leaves, gp.max_bin
+    sp = gp.split
+
+    leaf_id = jnp.zeros(n, dtype=jnp.int32)
+    hist0 = _psum(H.hist_leaf(bins, ghc, B, gp.hist_impl), gp)         # [F, B, 3]
+    g0, h0, c0 = hist0[0, :, 0].sum(), hist0[0, :, 1].sum(), hist0[0, :, 2].sum()
+
+    best0 = best_split(hist0, num_bins, na_bin, g0, h0, c0, feature_mask, sp,
+                       allow_split=_allow_depth(jnp.int32(0), gp) if gp.max_depth > 0 else True)
+
+    def tile(x, fill):
+        return jnp.full((L,), fill, dtype=x.dtype).at[0].set(x)
+
+    best = SplitResult(
+        gain=tile(best0.gain, NEG_INF), feature=tile(best0.feature, 0),
+        bin=tile(best0.bin, 0), default_left=tile(best0.default_left, False),
+        left_g=tile(best0.left_g, 0.0), left_h=tile(best0.left_h, 0.0),
+        left_cnt=tile(best0.left_cnt, 0.0))
+
+    hist = jnp.zeros((L, f, B, 3), dtype=jnp.float32).at[0].set(hist0)
+    state = _GrowState(
+        leaf_id=leaf_id, hist=hist,
+        leaf_g=jnp.zeros(L).at[0].set(g0),
+        leaf_h=jnp.zeros(L).at[0].set(h0),
+        leaf_cnt=jnp.zeros(L).at[0].set(c0),
+        leaf_depth=jnp.zeros(L, jnp.int32),
+        parent_node=jnp.full(L, -1, jnp.int32),
+        parent_right=jnp.zeros(L, dtype=bool),
+        best=best, tree=_empty_tree(L), done=jnp.bool_(L < 2),
+    )
+
+    def step(st: _GrowState, t):
+        l = jnp.argmax(st.best.gain).astype(jnp.int32)
+        ok = (st.best.gain[l] > NEG_INF / 2) & (~st.done)
+
+        def do_split(st: _GrowState) -> _GrowState:
+            new_leaf = t + 1
+            feat = st.best.feature[l]
+            thr = st.best.bin[l]
+            dleft = st.best.default_left[l]
+
+            # ---- partition rows (reference: DataPartition::Split,
+            # data_partition.hpp:113 — here a vectorized where on leaf_id) ----
+            col = bins[:, feat].astype(jnp.int32)
+            is_na = col == na_bin[feat]
+            go_right = jnp.where(is_na, ~dleft, col > thr)
+            in_leaf = st.leaf_id == l
+            leaf_id2 = jnp.where(in_leaf & go_right, new_leaf, st.leaf_id)
+
+            # ---- child stats ----
+            lg, lh, lc = st.best.left_g[l], st.best.left_h[l], st.best.left_cnt[l]
+            pg, ph, pc = st.leaf_g[l], st.leaf_h[l], st.leaf_cnt[l]
+            rg, rh, rc = pg - lg, ph - lh, pc - lc
+
+            # ---- smaller-child histogram + sibling by subtraction ----
+            small_is_left = lc <= rc
+            small_leaf = jnp.where(small_is_left, l, new_leaf)
+            mask = (leaf_id2 == small_leaf)
+            ghc_small = ghc * mask[:, None].astype(ghc.dtype)
+            hist_small = _psum(H.hist_leaf(bins, ghc_small, B, gp.hist_impl), gp)
+            hist_parent = st.hist[l]
+            hist_large = hist_parent - hist_small
+            hist_left = jnp.where(small_is_left, hist_small, hist_large)
+            hist_right = jnp.where(small_is_left, hist_large, hist_small)
+            hist2 = st.hist.at[l].set(hist_left).at[new_leaf].set(hist_right)
+
+            # ---- tree arrays (node t) ----
+            tr = st.tree
+            parent = st.parent_node[l]
+            has_parent = parent >= 0
+            pidx = jnp.maximum(parent, 0)
+            lc_arr = tr.left_child.at[pidx].set(
+                jnp.where(has_parent & ~st.parent_right[l], t, tr.left_child[pidx]))
+            rc_arr = tr.right_child.at[pidx].set(
+                jnp.where(has_parent & st.parent_right[l], t, tr.right_child[pidx]))
+            w_l = leaf_output(lg, lh, sp)
+            w_r = leaf_output(rg, rh, sp)
+            w_p = leaf_output(pg, ph, sp)
+            tr = TreeArrays(
+                split_feature=tr.split_feature.at[t].set(feat),
+                threshold_bin=tr.threshold_bin.at[t].set(thr),
+                default_left=tr.default_left.at[t].set(dleft),
+                left_child=lc_arr.at[t].set(~l),
+                right_child=rc_arr.at[t].set(~new_leaf),
+                split_gain=tr.split_gain.at[t].set(st.best.gain[l]),
+                leaf_value=tr.leaf_value.at[l].set(w_l).at[new_leaf].set(w_r),
+                leaf_weight=tr.leaf_weight.at[l].set(lh).at[new_leaf].set(rh),
+                leaf_count=tr.leaf_count.at[l].set(lc).at[new_leaf].set(rc),
+                internal_value=tr.internal_value.at[t].set(w_p),
+                internal_weight=tr.internal_weight.at[t].set(ph),
+                internal_count=tr.internal_count.at[t].set(pc),
+                num_leaves=tr.num_leaves + 1,
+            )
+
+            # ---- best splits for the two children ----
+            depth = st.leaf_depth[l] + 1
+            allow = _allow_depth(depth, gp) if gp.max_depth > 0 else jnp.bool_(True)
+            ch_hist = jnp.stack([hist_left, hist_right])
+            ch_g = jnp.stack([lg, rg])
+            ch_h = jnp.stack([lh, rh])
+            ch_c = jnp.stack([lc, rc])
+            bs = jax.vmap(lambda hh, g_, h_, c_: best_split(
+                hh, num_bins, na_bin, g_, h_, c_, feature_mask, sp, allow)
+            )(ch_hist, ch_g, ch_h, ch_c)
+
+            def upd(arr, vals):
+                return arr.at[l].set(vals[0]).at[new_leaf].set(vals[1])
+
+            best2 = SplitResult(*[upd(a, v) for a, v in zip(st.best, bs)])
+
+            return _GrowState(
+                leaf_id=leaf_id2, hist=hist2,
+                leaf_g=st.leaf_g.at[l].set(lg).at[new_leaf].set(rg),
+                leaf_h=st.leaf_h.at[l].set(lh).at[new_leaf].set(rh),
+                leaf_cnt=st.leaf_cnt.at[l].set(lc).at[new_leaf].set(rc),
+                leaf_depth=st.leaf_depth.at[l].set(depth).at[new_leaf].set(depth),
+                parent_node=st.parent_node.at[l].set(t).at[new_leaf].set(t),
+                parent_right=st.parent_right.at[l].set(False).at[new_leaf].set(True),
+                best=best2, tree=tr, done=st.done,
+            )
+
+        st2 = jax.lax.cond(ok, do_split, lambda s: s, st)
+        st2 = st2._replace(done=st2.done | ~ok)
+        return st2, None
+
+    if L >= 2:
+        state, _ = jax.lax.scan(step, state, jnp.arange(L - 1, dtype=jnp.int32))
+
+    tree = state.tree
+    # single-leaf tree: constant output
+    root_w = leaf_output(g0, h0, sp)
+    tree = tree._replace(
+        leaf_value=jnp.where(tree.num_leaves > 1, tree.leaf_value,
+                             tree.leaf_value.at[0].set(root_w)),
+        leaf_weight=jnp.where(tree.num_leaves > 1, tree.leaf_weight,
+                              tree.leaf_weight.at[0].set(h0)),
+        leaf_count=jnp.where(tree.num_leaves > 1, tree.leaf_count,
+                             tree.leaf_count.at[0].set(c0)),
+    )
+    return tree, state.leaf_id

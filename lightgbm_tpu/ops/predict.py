@@ -1,0 +1,116 @@
+"""Device-side prediction: route rows through trees.
+
+Reference analog: Tree::Predict / NumericalDecision node walk (tree.h:126,240) and
+the batch Predictor (predictor.hpp:29). On TPU the node walk is a bounded
+``fori_loop`` of vectorized gathers over the flat tree arrays — every row advances
+one level per iteration; finished rows park on their leaf (pointer < 0 is a leaf,
+encoded ~leaf_index, matching the reference's child encoding).
+"""
+from __future__ import annotations
+
+from functools import partial
+
+import jax
+import jax.numpy as jnp
+
+
+def route_bins(split_feature, threshold_bin, default_left, left_child, right_child,
+               num_leaves, bins, na_bin, max_steps: int):
+    """Leaf index for each row of a *binned* matrix. bins: [N, F] uint8."""
+    n = bins.shape[0]
+    # pointer: >=0 internal node, <0 leaf (~leaf)
+    start = jnp.where(num_leaves > 1, 0, -1)
+    ptr = jnp.full((n,), start, dtype=jnp.int32)
+
+    def body(_, ptr):
+        node = jnp.maximum(ptr, 0)
+        feat = split_feature[node]
+        thr = threshold_bin[node]
+        col = jnp.take_along_axis(bins, feat[:, None].astype(jnp.int32), axis=1)[:, 0]
+        col = col.astype(jnp.int32)
+        is_na = col == na_bin[feat]
+        go_left = jnp.where(is_na, default_left[node], col <= thr)
+        nxt = jnp.where(go_left, left_child[node], right_child[node])
+        return jnp.where(ptr >= 0, nxt, ptr)
+
+    ptr = jax.lax.fori_loop(0, max_steps, body, ptr)
+    return jnp.invert(jnp.minimum(ptr, -1))  # ~ptr, leaves only
+
+
+def route_raw(split_feature, threshold_real, default_left, left_child, right_child,
+              num_leaves, x, missing_type, zero_as_missing_eps, max_steps: int):
+    """Leaf index for raw (unbinned) float rows x: [N, F] f64/f32.
+
+    missing_type: [F] i32 (0 none / 1 zero / 2 nan), mirroring the reference's
+    per-feature missing handling at predict time (tree.h:240 NumericalDecision).
+    """
+    n = x.shape[0]
+    start = jnp.where(num_leaves > 1, 0, -1)
+    ptr = jnp.full((n,), start, dtype=jnp.int32)
+
+    def body(_, ptr):
+        node = jnp.maximum(ptr, 0)
+        feat = split_feature[node]
+        thr = threshold_real[node]
+        v = jnp.take_along_axis(x, feat[:, None].astype(jnp.int32), axis=1)[:, 0]
+        mt = missing_type[feat]
+        isnan = jnp.isnan(v)
+        # missing_type None: NaN treated as 0 (reference converts NaN->0)
+        v0 = jnp.where(isnan & (mt == 0), 0.0, v)
+        is_missing = jnp.where(
+            mt == 2, isnan,
+            jnp.where(mt == 1, (jnp.abs(v0) < zero_as_missing_eps) | isnan,
+                      jnp.zeros_like(isnan)))
+        # non-missing NaN can only occur under missing_type None, where v0 == 0
+        go_left = jnp.where(is_missing, default_left[node], v0 <= thr)
+        nxt = jnp.where(go_left, left_child[node], right_child[node])
+        return jnp.where(ptr >= 0, nxt, ptr)
+
+    ptr = jax.lax.fori_loop(0, max_steps, body, ptr)
+    return jnp.invert(jnp.minimum(ptr, -1))
+
+
+@partial(jax.jit, static_argnames=("max_steps",))
+def predict_bins_ensemble(tree_stack, bins, na_bin, max_steps: int):
+    """Sum of leaf values over a stacked ensemble, on binned data.
+
+    tree_stack: dict of arrays with leading tree axis [T, ...] (from
+    models.tree.stack_trees). Returns [N] f32 raw scores (no init score).
+    """
+    def one(sf, tb, dl, lc, rc, nl, lv):
+        leaf = route_bins(sf, tb, dl, lc, rc, nl, bins, na_bin, max_steps)
+        return lv[leaf]
+
+    per_tree = jax.vmap(one)(
+        tree_stack["split_feature"], tree_stack["threshold_bin"],
+        tree_stack["default_left"], tree_stack["left_child"],
+        tree_stack["right_child"], tree_stack["num_leaves"],
+        tree_stack["leaf_value"])
+    return per_tree.sum(axis=0)
+
+
+@partial(jax.jit, static_argnames=("max_steps",))
+def predict_raw_ensemble(tree_stack, x, missing_type, max_steps: int):
+    """Sum of leaf values over a stacked ensemble, on raw features."""
+    def one(sf, tr, dl, lc, rc, nl, lv):
+        leaf = route_raw(sf, tr, dl, lc, rc, nl, x, missing_type, 1e-35, max_steps)
+        return lv[leaf]
+
+    per_tree = jax.vmap(one)(
+        tree_stack["split_feature"], tree_stack["threshold_real"],
+        tree_stack["default_left"], tree_stack["left_child"],
+        tree_stack["right_child"], tree_stack["num_leaves"],
+        tree_stack["leaf_value"])
+    return per_tree.sum(axis=0)
+
+
+@partial(jax.jit, static_argnames=("max_steps",))
+def predict_leaf_ensemble(tree_stack, x, missing_type, max_steps: int):
+    """Per-tree leaf indices (reference: predict_leaf_index, boosting.h:159)."""
+    def one(sf, tr, dl, lc, rc, nl):
+        return route_raw(sf, tr, dl, lc, rc, nl, x, missing_type, 1e-35, max_steps)
+
+    return jax.vmap(one)(
+        tree_stack["split_feature"], tree_stack["threshold_real"],
+        tree_stack["default_left"], tree_stack["left_child"],
+        tree_stack["right_child"], tree_stack["num_leaves"]).T  # [N, T]

@@ -1,0 +1,45 @@
+"""Data-parallel tree growing over a device mesh.
+
+TPU-native re-design of the reference's DataParallelTreeLearner
+(src/treelearner/data_parallel_tree_learner.cpp): rows are sharded over the mesh's
+``data`` axis; per-leaf histograms are reduced with ``psum`` inside ``shard_map``
+(replacing the reference's ReduceScatter of serialized histogram buffers,
+data_parallel_tree_learner.cpp:149-164 + network.cpp:232); best-split selection runs
+replicated on every shard, which also replaces the reference's
+``SyncUpGlobalBestSplit`` argmax-allreduce (parallel_tree_learner.h:190-213) — every
+shard sees identical reduced histograms so no second collective is needed.
+"""
+from __future__ import annotations
+
+from functools import partial
+from typing import Tuple
+
+import jax
+import jax.numpy as jnp
+from jax.sharding import Mesh, NamedSharding, PartitionSpec as P
+
+from ..ops.grow import GrowParams, TreeArrays, grow_tree
+from .mesh import DATA_AXIS
+
+
+def grow_tree_dp(bins, ghc, num_bins, na_bin, feature_mask,
+                 gp: GrowParams, mesh: Mesh) -> Tuple[TreeArrays, jnp.ndarray]:
+    """Grow one tree with rows sharded over ``mesh``'s data axis.
+
+    bins/ghc must already be sharded along rows (or will be resharded here);
+    the returned TreeArrays are replicated, leaf_id stays row-sharded.
+    """
+    axis = mesh.axis_names[0]
+    gp_dp = gp if gp.axis_name == axis else \
+        GrowParams(num_leaves=gp.num_leaves, max_depth=gp.max_depth,
+                   max_bin=gp.max_bin, split=gp.split, hist_impl=gp.hist_impl,
+                   axis_name=axis)
+
+    fn = jax.shard_map(
+        partial(grow_tree, gp=gp_dp),
+        mesh=mesh,
+        in_specs=(P(axis, None), P(axis, None), P(), P(), P()),
+        out_specs=(TreeArrays(*([P()] * len(TreeArrays._fields))), P(axis)),
+        check_vma=False,
+    )
+    return fn(bins, ghc, num_bins, na_bin, feature_mask)

@@ -1,0 +1,60 @@
+"""Device mesh utilities.
+
+TPU-native replacement for the reference's machine-list/network bootstrap
+(src/network/linkers_socket.cpp:80-224, Network::Init network.cpp:30): there are no
+sockets or machine files — a ``jax.sharding.Mesh`` over the local (or
+jax.distributed multi-host) device set plays the role of the linker topology, and
+XLA collectives ride ICI/DCN automatically.
+"""
+from __future__ import annotations
+
+from typing import Optional, Sequence
+
+import jax
+import numpy as np
+from jax.sharding import Mesh, NamedSharding, PartitionSpec as P
+
+from ..utils import log
+
+DATA_AXIS = "data"
+
+
+def make_mesh(num_devices: Optional[int] = None, axis_name: str = DATA_AXIS,
+              devices: Optional[Sequence] = None) -> Mesh:
+    """1-D data-parallel mesh over the available devices."""
+    devs = list(devices) if devices is not None else jax.devices()
+    if num_devices is not None:
+        devs = devs[:num_devices]
+    return Mesh(np.array(devs), (axis_name,))
+
+
+def shard_rows(x, mesh: Mesh, axis_name: str = DATA_AXIS):
+    """Place an array sharded along its leading (row) axis."""
+    spec = P(axis_name, *([None] * (x.ndim - 1)))
+    return jax.device_put(x, NamedSharding(mesh, spec))
+
+
+def replicate(x, mesh: Mesh):
+    return jax.device_put(x, NamedSharding(mesh, P()))
+
+
+def pad_rows_to_devices(x: np.ndarray, n_dev: int):
+    """Pad row count to a multiple of the mesh size; returns (padded, orig_n)."""
+    n = x.shape[0]
+    pad = (-n) % n_dev
+    if pad:
+        pad_width = [(0, pad)] + [(0, 0)] * (x.ndim - 1)
+        x = np.pad(x, pad_width)
+    return x, n
+
+
+def init_distributed(config) -> None:
+    """Multi-host initialization (reference analog: Network::Init + machine list;
+    here a thin wrapper over jax.distributed)."""
+    if config.num_machines > 1 and config.machines:
+        coords = config.machines.split(",")[0]
+        jax.distributed.initialize(
+            coordinator_address=coords,
+            num_processes=config.num_machines)
+        log.info(f"jax.distributed initialized: process {jax.process_index()} "
+                 f"of {jax.process_count()}")

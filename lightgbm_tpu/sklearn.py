@@ -1,0 +1,316 @@
+"""scikit-learn estimator wrappers.
+
+Mirrors the reference python package's sklearn API (python-package/lightgbm/
+sklearn.py:169 LGBMModel, :742 LGBMRegressor, :769 LGBMClassifier, :911 LGBMRanker),
+including the objective/eval-function adapters that translate sklearn-style
+signatures into grad/hess providers (:18 _ObjectiveFunctionWrapper).
+"""
+from __future__ import annotations
+
+import copy
+from typing import Any, Callable, Dict, List, Optional, Union
+
+import numpy as np
+
+from .basic import Booster, Dataset
+from .callback import EarlyStopException
+from .engine import train as _train
+from .utils import log
+
+
+class _ObjectiveFunctionWrapper:
+    """Adapt fobj(y_true, y_pred) -> (grad, hess) to the engine signature
+    (reference: sklearn.py:18)."""
+
+    def __init__(self, func):
+        self.func = func
+
+    def __call__(self, preds, dataset):
+        labels = np.asarray(dataset.label)
+        argc = self.func.__code__.co_argcount
+        if argc == 2:
+            return self.func(labels, np.asarray(preds))
+        if argc == 3:
+            return self.func(labels, np.asarray(preds), dataset.get_group())
+        raise TypeError(f"Self-defined objective takes 2 or 3 arguments, got {argc}")
+
+
+class _EvalFunctionWrapper:
+    """Adapt feval(y_true, y_pred) -> (name, value, greater_is_better)
+    (reference: sklearn.py:97)."""
+
+    def __init__(self, func):
+        self.func = func
+
+    def __call__(self, preds, dataset):
+        labels = np.asarray(dataset.label)
+        argc = self.func.__code__.co_argcount
+        if argc == 2:
+            return self.func(labels, np.asarray(preds))
+        if argc == 3:
+            w = dataset.get_weight()
+            return self.func(labels, np.asarray(preds), w)
+        if argc == 4:
+            return self.func(labels, np.asarray(preds), dataset.get_weight(),
+                             dataset.get_group())
+        raise TypeError("Self-defined eval function takes 2-4 arguments")
+
+
+class LGBMModel:
+    """Base sklearn estimator (reference: LGBMModel, sklearn.py:169)."""
+
+    def __init__(self, boosting_type="gbdt", num_leaves=31, max_depth=-1,
+                 learning_rate=0.1, n_estimators=100,
+                 subsample_for_bin=200000, objective=None, class_weight=None,
+                 min_split_gain=0.0, min_child_weight=1e-3, min_child_samples=20,
+                 subsample=1.0, subsample_freq=0, colsample_bytree=1.0,
+                 reg_alpha=0.0, reg_lambda=0.0, random_state=None,
+                 n_jobs=-1, silent=True, importance_type="split", **kwargs):
+        self.boosting_type = boosting_type
+        self.num_leaves = num_leaves
+        self.max_depth = max_depth
+        self.learning_rate = learning_rate
+        self.n_estimators = n_estimators
+        self.subsample_for_bin = subsample_for_bin
+        self.objective = objective
+        self.class_weight = class_weight
+        self.min_split_gain = min_split_gain
+        self.min_child_weight = min_child_weight
+        self.min_child_samples = min_child_samples
+        self.subsample = subsample
+        self.subsample_freq = subsample_freq
+        self.colsample_bytree = colsample_bytree
+        self.reg_alpha = reg_alpha
+        self.reg_lambda = reg_lambda
+        self.random_state = random_state
+        self.n_jobs = n_jobs
+        self.silent = silent
+        self.importance_type = importance_type
+        self._other_params = dict(kwargs)
+        self._Booster: Optional[Booster] = None
+        self._n_features = None
+        self._classes = None
+        self._n_classes = None
+        self._objective = objective
+        self._evals_result = None
+        self._best_iteration = None
+        self._best_score = None
+
+    # -- sklearn plumbing --
+    def get_params(self, deep=True) -> Dict[str, Any]:
+        params = {k: getattr(self, k) for k in (
+            "boosting_type", "num_leaves", "max_depth", "learning_rate",
+            "n_estimators", "subsample_for_bin", "objective", "class_weight",
+            "min_split_gain", "min_child_weight", "min_child_samples", "subsample",
+            "subsample_freq", "colsample_bytree", "reg_alpha", "reg_lambda",
+            "random_state", "n_jobs", "silent", "importance_type")}
+        params.update(self._other_params)
+        return params
+
+    def set_params(self, **params) -> "LGBMModel":
+        for key, value in params.items():
+            if hasattr(self, key):
+                setattr(self, key, value)
+            else:
+                self._other_params[key] = value
+        return self
+
+    def _make_train_params(self) -> Dict[str, Any]:
+        params = self.get_params()
+        params.pop("silent", None)
+        params.pop("importance_type", None)
+        params.pop("n_estimators", None)
+        params.pop("class_weight", None)
+        params["objective"] = self._objective or "regression"
+        if callable(self._objective):
+            params["objective"] = "none"
+        params["verbosity"] = -1 if self.silent else 1
+        if self.random_state is not None:
+            params["seed"] = int(self.random_state)
+        params.pop("random_state", None)
+        params.pop("n_jobs", None)
+        return params
+
+    def fit(self, X, y, sample_weight=None, init_score=None, group=None,
+            eval_set=None, eval_names=None, eval_sample_weight=None,
+            eval_init_score=None, eval_group=None, eval_metric=None,
+            early_stopping_rounds=None, verbose=False, feature_name="auto",
+            categorical_feature="auto", callbacks=None) -> "LGBMModel":
+        params = self._make_train_params()
+        if eval_metric is not None and not callable(eval_metric):
+            params["metric"] = eval_metric
+
+        fobj = _ObjectiveFunctionWrapper(self._objective) if callable(self._objective) else None
+        feval = _EvalFunctionWrapper(eval_metric) if callable(eval_metric) else None
+
+        if self.class_weight is not None and self._n_classes is None:
+            sample_weight = self._apply_class_weight(y, sample_weight)
+
+        train_set = Dataset(X, label=y, weight=sample_weight, group=group,
+                            init_score=init_score, params=params,
+                            categorical_feature=categorical_feature,
+                            feature_name=feature_name)
+        valid_sets = []
+        valid_names = []
+        if eval_set is not None:
+            for i, (vx, vy) in enumerate(eval_set):
+                if vx is X and vy is y:
+                    valid_sets.append(train_set)
+                else:
+                    vw = eval_sample_weight[i] if eval_sample_weight else None
+                    vg = eval_group[i] if eval_group else None
+                    vi = eval_init_score[i] if eval_init_score else None
+                    valid_sets.append(train_set.create_valid(
+                        vx, label=vy, weight=vw, group=vg, init_score=vi))
+                valid_names.append(eval_names[i] if eval_names else f"valid_{i}")
+
+        evals_result: Dict = {}
+        self._Booster = _train(
+            params, train_set, num_boost_round=self.n_estimators,
+            valid_sets=valid_sets, valid_names=valid_names,
+            fobj=fobj, feval=feval,
+            early_stopping_rounds=early_stopping_rounds,
+            evals_result=evals_result, verbose_eval=verbose,
+            callbacks=callbacks)
+        self._evals_result = evals_result
+        self._n_features = np.asarray(X).shape[1] if hasattr(X, "shape") else len(X[0])
+        self._best_iteration = self._Booster.best_iteration
+        self._best_score = self._Booster.best_score
+        self.fitted_ = True
+        return self
+
+    def _apply_class_weight(self, y, sample_weight):
+        from sklearn.utils.class_weight import compute_sample_weight
+        cw = compute_sample_weight(self.class_weight, y)
+        if sample_weight is None:
+            return cw
+        return np.asarray(sample_weight) * cw
+
+    def predict(self, X, raw_score=False, num_iteration=None, pred_leaf=False,
+                pred_contrib=False, **kwargs):
+        if self._Booster is None:
+            raise ValueError("Estimator not fitted")
+        return self._Booster.predict(X, raw_score=raw_score,
+                                     num_iteration=num_iteration,
+                                     pred_leaf=pred_leaf,
+                                     pred_contrib=pred_contrib)
+
+    @property
+    def booster_(self) -> Booster:
+        if self._Booster is None:
+            raise ValueError("No booster found; call fit first")
+        return self._Booster
+
+    @property
+    def evals_result_(self):
+        return self._evals_result
+
+    @property
+    def best_iteration_(self):
+        return self._best_iteration
+
+    @property
+    def best_score_(self):
+        return self._best_score
+
+    @property
+    def n_features_(self):
+        return self._n_features
+
+    @property
+    def n_features_in_(self):
+        return self._n_features
+
+    @property
+    def feature_importances_(self):
+        return self.booster_.feature_importance(self.importance_type)
+
+    @property
+    def feature_name_(self):
+        return self.booster_.feature_name()
+
+
+class LGBMRegressor(LGBMModel):
+    """Reference: sklearn.py:742."""
+
+    def fit(self, X, y, **kwargs):
+        if self._objective is None:
+            self._objective = "regression"
+        return super().fit(X, y, **kwargs)
+
+    def score(self, X, y):  # R^2, sklearn convention
+        from sklearn.metrics import r2_score
+        return r2_score(y, self.predict(X))
+
+
+class LGBMClassifier(LGBMModel):
+    """Reference: sklearn.py:769."""
+
+    def fit(self, X, y, **kwargs):
+        y = np.asarray(y)
+        self._classes = np.unique(y)
+        self._n_classes = len(self._classes)
+        self._le_map = {c: i for i, c in enumerate(self._classes)}
+        y_enc = np.searchsorted(self._classes, y)
+        if self._n_classes > 2:
+            if self._objective is None or self._objective in ("multiclass",):
+                self._objective = "multiclass"
+            self._other_params["num_class"] = self._n_classes
+        else:
+            if self._objective is None:
+                self._objective = "binary"
+        if self.class_weight is not None:
+            kwargs.setdefault("sample_weight", None)
+            kwargs["sample_weight"] = self._apply_class_weight(
+                y_enc, kwargs.get("sample_weight"))
+        return super().fit(X, y_enc, **kwargs)
+
+    def predict(self, X, raw_score=False, num_iteration=None, pred_leaf=False,
+                pred_contrib=False, **kwargs):
+        result = self.predict_proba(X, raw_score=raw_score,
+                                    num_iteration=num_iteration,
+                                    pred_leaf=pred_leaf,
+                                    pred_contrib=pred_contrib)
+        if raw_score or pred_leaf or pred_contrib:
+            return result
+        if self._n_classes > 2:
+            idx = np.argmax(result, axis=1)
+        else:
+            idx = (result[:, 1] > 0.5).astype(int)
+        return self._classes[idx]
+
+    def predict_proba(self, X, raw_score=False, num_iteration=None,
+                      pred_leaf=False, pred_contrib=False, **kwargs):
+        result = super().predict(X, raw_score=raw_score,
+                                 num_iteration=num_iteration,
+                                 pred_leaf=pred_leaf, pred_contrib=pred_contrib)
+        if raw_score or pred_leaf or pred_contrib:
+            return result
+        if self._n_classes <= 2 and result.ndim == 1:
+            return np.stack([1.0 - result, result], axis=1)
+        return result
+
+    def score(self, X, y):
+        return float((self.predict(X) == np.asarray(y)).mean())
+
+    @property
+    def classes_(self):
+        return self._classes
+
+    @property
+    def n_classes_(self):
+        return self._n_classes
+
+
+class LGBMRanker(LGBMModel):
+    """Reference: sklearn.py:911."""
+
+    def fit(self, X, y, group=None, eval_group=None, eval_at=(1, 2, 3, 4, 5),
+            **kwargs):
+        if group is None:
+            raise ValueError("Should set group for ranking task")
+        if self._objective is None:
+            self._objective = "lambdarank"
+        self._other_params.setdefault("metric", "ndcg")
+        self._other_params["eval_at"] = list(eval_at)
+        return super().fit(X, y, group=group, eval_group=eval_group, **kwargs)

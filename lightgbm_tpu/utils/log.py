@@ -1,0 +1,72 @@
+"""Leveled logging with a redirectable callback.
+
+TPU-native equivalent of the reference logger (include/LightGBM/utils/log.h:48):
+four levels, ``Fatal`` raises, and an optional user callback that receives every
+formatted line (used by the Python/R bindings of the reference to redirect logs).
+"""
+from __future__ import annotations
+
+import sys
+from typing import Callable, Optional
+
+FATAL = -1
+WARNING = 0
+INFO = 1
+DEBUG = 2
+
+_level = INFO
+_callback: Optional[Callable[[str], None]] = None
+
+
+class LightGBMError(RuntimeError):
+    """Raised by fatal errors (reference: Log::Fatal throws std::runtime_error)."""
+
+
+def set_level(level: int) -> None:
+    global _level
+    _level = level
+
+
+def get_level() -> int:
+    return _level
+
+
+def set_callback(cb: Optional[Callable[[str], None]]) -> None:
+    global _callback
+    _callback = cb
+
+
+def _emit(tag: str, msg: str) -> None:
+    line = f"[LightGBM-TPU] [{tag}] {msg}\n"
+    if _callback is not None:
+        _callback(line)
+    else:
+        sys.stderr.write(line)
+        sys.stderr.flush()
+
+
+def debug(msg: str, *args) -> None:
+    if _level >= DEBUG:
+        _emit("Debug", msg % args if args else msg)
+
+
+def info(msg: str, *args) -> None:
+    if _level >= INFO:
+        _emit("Info", msg % args if args else msg)
+
+
+def warning(msg: str, *args) -> None:
+    if _level >= WARNING:
+        _emit("Warning", msg % args if args else msg)
+
+
+def fatal(msg: str, *args) -> None:
+    text = msg % args if args else msg
+    _emit("Fatal", text)
+    raise LightGBMError(text)
+
+
+def check(cond: bool, msg: str = "check failed") -> None:
+    """Reference CHECK macro (utils/log.h)."""
+    if not cond:
+        fatal(msg)

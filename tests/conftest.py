@@ -1,0 +1,25 @@
+"""Test configuration: run everything on a virtual 8-device CPU mesh so that
+distributed (shard_map) paths are exercised without TPU hardware
+(SURVEY.md §4: single-process multi-device testing the reference never had)."""
+import os
+
+os.environ["JAX_PLATFORMS"] = "cpu"
+flags = os.environ.get("XLA_FLAGS", "")
+if "xla_force_host_platform_device_count" not in flags:
+    os.environ["XLA_FLAGS"] = (flags + " --xla_force_host_platform_device_count=8").strip()
+
+import numpy as np
+import pytest
+
+import jax
+
+# the axon TPU plugin ignores JAX_PLATFORMS; force the CPU backend explicitly
+jax.config.update("jax_platforms", "cpu")
+jax.config.update("jax_compilation_cache_dir", "/tmp/jax_cache_lgbm_tpu")
+jax.config.update("jax_persistent_cache_min_compile_time_secs", 1.0)
+jax.config.update("jax_persistent_cache_enable_xla_caches", "all")
+
+
+@pytest.fixture
+def rng():
+    return np.random.RandomState(42)

@@ -1,0 +1,116 @@
+"""BinMapper tests (reference analog: bin finding in src/io/bin.cpp, exercised via
+missing-value mode tests in test_engine.py:117-238)."""
+import numpy as np
+import pytest
+
+from lightgbm_tpu.binning import (BIN_CATEGORICAL, MISSING_NAN, MISSING_NONE,
+                                  MISSING_ZERO, BinMapper, bin_data,
+                                  find_bin_mappers)
+
+
+def test_distinct_small():
+    v = np.array([1.0, 2.0, 3.0, 1.0, 2.0, 3.0] * 10)
+    m = BinMapper.from_sample(v, len(v), max_bin=255)
+    assert m.num_bins == 3
+    b = m.values_to_bins(np.array([1.0, 2.0, 3.0]))
+    assert len(set(b.tolist())) == 3
+    # order preserved
+    assert b[0] < b[1] < b[2]
+
+
+def test_bounds_monotone_and_inf():
+    rng = np.random.RandomState(0)
+    v = rng.randn(5000)
+    m = BinMapper.from_sample(v, len(v), max_bin=63)
+    assert m.num_bins <= 63
+    ub = m.upper_bounds
+    assert np.all(np.diff(ub[:-1]) > 0)
+    assert np.isinf(ub[-1])
+
+
+def test_bin_mapping_respects_bounds():
+    rng = np.random.RandomState(1)
+    v = rng.randn(2000)
+    m = BinMapper.from_sample(v, len(v), max_bin=31)
+    test_v = rng.randn(500)
+    b = m.values_to_bins(test_v)
+    ub = m.upper_bounds
+    for val, bi in zip(test_v, b):
+        assert val <= ub[bi] + 1e-12
+        if bi > 0:
+            assert val > ub[bi - 1] - 1e-12
+
+
+def test_missing_nan():
+    v = np.concatenate([np.random.RandomState(2).randn(1000), [np.nan] * 100])
+    m = BinMapper.from_sample(v, len(v), max_bin=31, use_missing=True)
+    assert m.missing_type == MISSING_NAN
+    assert m.na_bin == m.num_bins - 1
+    b = m.values_to_bins(np.array([np.nan, 0.0]))
+    assert b[0] == m.na_bin
+    assert b[1] != m.na_bin
+
+
+def test_missing_disabled():
+    v = np.concatenate([np.random.RandomState(2).randn(1000), [np.nan] * 100])
+    m = BinMapper.from_sample(v, len(v), max_bin=31, use_missing=False)
+    assert m.missing_type == MISSING_NONE
+    # NaN behaves like zero
+    b = m.values_to_bins(np.array([np.nan]))
+    b0 = m.values_to_bins(np.array([0.0]))
+    assert b[0] == b0[0]
+
+
+def test_zero_as_missing():
+    v = np.concatenate([np.random.RandomState(3).randn(500), np.zeros(500)])
+    m = BinMapper.from_sample(v, len(v), max_bin=31, zero_as_missing=True)
+    assert m.missing_type == MISSING_ZERO
+    assert m.na_bin == m.default_bin
+    assert m.values_to_bins(np.array([0.0]))[0] == m.default_bin
+
+
+def test_zero_bin_isolated():
+    v = np.concatenate([np.random.RandomState(4).randn(1000), np.zeros(200)])
+    m = BinMapper.from_sample(v, len(v), max_bin=63)
+    zb = m.values_to_bins(np.array([0.0]))[0]
+    near = m.values_to_bins(np.array([1e-40, -1e-40]))
+    assert near[0] == zb and near[1] == zb  # sub-threshold values share the zero bin
+    assert m.values_to_bins(np.array([0.5]))[0] != zb
+
+
+def test_trivial_feature():
+    v = np.full(100, 3.0)
+    m = BinMapper.from_sample(v, len(v), max_bin=31)
+    assert m.is_trivial
+
+
+def test_categorical():
+    rng = np.random.RandomState(5)
+    v = rng.choice([0, 1, 2, 7, 9], size=1000, p=[0.4, 0.3, 0.15, 0.1, 0.05]).astype(float)
+    m = BinMapper.from_sample(v, len(v), max_bin=31, bin_type=BIN_CATEGORICAL)
+    assert m.bin_type == BIN_CATEGORICAL
+    b = m.values_to_bins(v)
+    # each category maps to a unique bin
+    assert len(np.unique(b[v == 0])) == 1
+    assert len(np.unique(b)) == 5
+    # most frequent category is bin 1
+    assert m.cat_values[0] == 0
+
+
+def test_bin_data_drops_trivial():
+    rng = np.random.RandomState(6)
+    X = np.stack([rng.randn(100), np.full(100, 1.0), rng.randn(100)], axis=1)
+    mappers = find_bin_mappers(X, max_bin=15)
+    ds = bin_data(X, mappers)
+    assert ds.num_features == 2
+    assert list(ds.feature_map) == [0, 2]
+
+
+def test_equal_freq_binning():
+    rng = np.random.RandomState(7)
+    v = rng.exponential(size=10000)
+    m = BinMapper.from_sample(v, len(v), max_bin=16, min_data_in_bin=3)
+    b = m.values_to_bins(v)
+    counts = np.bincount(b, minlength=m.num_bins)
+    # roughly equal frequency: no bin more than 4x the ideal share
+    assert counts.max() < 4 * len(v) / m.num_bins

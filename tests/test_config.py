@@ -1,0 +1,58 @@
+"""Config system tests (reference test analog: config parsing in test_basic.py and
+the generated alias table config_auto.cpp:10)."""
+import pytest
+
+from lightgbm_tpu.config import Config, canonical_name
+from lightgbm_tpu.utils.log import LightGBMError
+
+
+def test_defaults():
+    c = Config()
+    assert c.num_leaves == 31
+    assert c.learning_rate == 0.1
+    assert c.objective == "regression"
+    assert c.max_bin == 255
+    assert c.boosting == "gbdt"
+
+
+def test_aliases():
+    assert canonical_name("n_estimators") == "num_iterations"
+    assert canonical_name("eta") == "learning_rate"
+    assert canonical_name("sub_row") == "bagging_fraction"
+    assert canonical_name("min_child_samples") == "min_data_in_leaf"
+    c = Config({"n_estimators": 50, "eta": 0.3, "max_leaf": 10})
+    assert c.num_iterations == 50
+    assert c.learning_rate == 0.3
+    assert c.num_leaves == 10
+
+
+def test_type_coercion():
+    c = Config({"learning_rate": "0.05", "num_leaves": "64", "is_unbalance": "true",
+                "bagging_fraction": 1, "metric": "auc,binary_logloss"})
+    assert c.learning_rate == 0.05
+    assert c.num_leaves == 64
+    assert c.is_unbalance is True
+    assert isinstance(c.bagging_fraction, float)
+    assert c.metric == ["auc", "binary_logloss"]
+
+
+def test_str2map_and_comments():
+    kv = Config.str2map(["num_leaves=8", "# comment", "metric=l2 # inline", ""])
+    assert kv["num_leaves"] == "8"
+    assert kv["metric"] == "l2"
+
+
+def test_seed_fanout():
+    c = Config({"seed": 10})
+    assert c.data_random_seed == 11
+    assert c.bagging_seed == 12
+
+
+def test_invalid():
+    with pytest.raises(LightGBMError):
+        Config({"num_leaves": 1})
+
+
+def test_extra_params_kept():
+    c = Config({"my_custom_thing": 5})
+    assert c.extra["my_custom_thing"] == 5

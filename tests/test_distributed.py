@@ -1,0 +1,73 @@
+"""Distributed (data-parallel) tests on the virtual 8-device CPU mesh.
+
+This is the test the reference never had (SURVEY.md §4: multi-machine behavior was
+only validated manually via examples/parallel_learning): data-parallel training is
+checked for equality against serial training in-process.
+"""
+import numpy as np
+import pytest
+
+import jax
+import jax.numpy as jnp
+
+from sklearn.datasets import make_classification
+from sklearn.metrics import roc_auc_score
+
+import lightgbm_tpu as lgb
+from lightgbm_tpu.ops.grow import GrowParams, grow_tree
+from lightgbm_tpu.ops.split import SplitParams
+from lightgbm_tpu.parallel.data_parallel import grow_tree_dp
+from lightgbm_tpu.parallel.mesh import make_mesh, shard_rows
+
+
+@pytest.fixture(scope="module")
+def mesh():
+    assert len(jax.devices()) >= 8, "conftest should provide 8 virtual devices"
+    return make_mesh(8)
+
+
+def test_dp_tree_matches_serial(mesh):
+    rng = np.random.RandomState(0)
+    n, f, b = 800, 5, 16
+    bins = jnp.asarray(rng.randint(0, b, size=(n, f)).astype(np.uint8))
+    g = rng.randn(n).astype(np.float32)
+    h = np.ones(n, dtype=np.float32)
+    ghc = jnp.asarray(np.stack([g, h, h], axis=1))
+    num_bins = jnp.full(f, b, dtype=jnp.int32)
+    na_bin = jnp.full(f, 256, dtype=jnp.int32)
+    fmask = jnp.ones(f, dtype=bool)
+    gp = GrowParams(num_leaves=8, max_bin=b,
+                    split=SplitParams(min_data_in_leaf=5), hist_impl="scatter")
+
+    tree_s, leaf_s = grow_tree(bins, ghc, num_bins, na_bin, fmask, gp)
+    bins_dp = shard_rows(bins, mesh)
+    ghc_dp = shard_rows(ghc, mesh)
+    tree_d, leaf_d = grow_tree_dp(bins_dp, ghc_dp, num_bins, na_bin, fmask, gp, mesh)
+
+    assert int(tree_s.num_leaves) == int(tree_d.num_leaves)
+    np.testing.assert_array_equal(np.asarray(tree_s.split_feature),
+                                  np.asarray(tree_d.split_feature))
+    np.testing.assert_array_equal(np.asarray(tree_s.threshold_bin),
+                                  np.asarray(tree_d.threshold_bin))
+    np.testing.assert_allclose(np.asarray(tree_s.leaf_value),
+                               np.asarray(tree_d.leaf_value), rtol=1e-4, atol=1e-5)
+    np.testing.assert_array_equal(np.asarray(leaf_s), np.asarray(leaf_d))
+
+
+def test_dp_end_to_end_auc():
+    X, y = make_classification(n_samples=1000, n_features=10, random_state=0)
+    ds = lgb.Dataset(X, label=y)
+    bst = lgb.train({"objective": "binary", "tree_learner": "data",
+                     "num_leaves": 7, "verbosity": -1, "min_data_in_leaf": 5},
+                    ds, num_boost_round=20)
+    assert roc_auc_score(y, bst.predict(X)) > 0.9
+
+
+def test_dp_equals_serial_training():
+    X, y = make_classification(n_samples=600, n_features=8, random_state=1)
+    p = {"objective": "binary", "num_leaves": 7, "verbosity": -1,
+         "min_data_in_leaf": 5, "histogram_impl": "scatter"}
+    b1 = lgb.train(p, lgb.Dataset(X, label=y), num_boost_round=10)
+    b2 = lgb.train({**p, "tree_learner": "data"}, lgb.Dataset(X, label=y),
+                   num_boost_round=10)
+    np.testing.assert_allclose(b1.predict(X), b2.predict(X), rtol=1e-3, atol=1e-4)

@@ -1,0 +1,180 @@
+"""Core device-op tests: histogram kernels and split search vs numpy brute force
+(the reference has no C++ unit tests — SURVEY.md §4 says do better)."""
+import numpy as np
+import pytest
+
+import jax.numpy as jnp
+
+from lightgbm_tpu.ops import histogram as H
+from lightgbm_tpu.ops.split import SplitParams, best_split, leaf_output
+from lightgbm_tpu.ops.grow import GrowParams, grow_tree
+
+
+def _rand_problem(n=500, f=4, b=16, seed=0):
+    rng = np.random.RandomState(seed)
+    bins = rng.randint(0, b, size=(n, f)).astype(np.uint8)
+    g = rng.randn(n).astype(np.float32)
+    h = rng.rand(n).astype(np.float32) + 0.5
+    return bins, g, h
+
+
+def _np_hist(bins, ghc, b):
+    n, f = bins.shape
+    out = np.zeros((f, b, 3))
+    for j in range(f):
+        for i in range(n):
+            out[j, bins[i, j]] += ghc[i]
+    return out
+
+
+@pytest.mark.parametrize("impl", ["scatter", "onehot"])
+def test_hist_leaf_matches_numpy(impl):
+    bins, g, h = _rand_problem()
+    ghc = np.stack([g, h, np.ones_like(g)], axis=1)
+    ref = _np_hist(bins, ghc, 16)
+    out = np.asarray(H.hist_leaf(jnp.asarray(bins), jnp.asarray(ghc), 16, impl))
+    np.testing.assert_allclose(out, ref, rtol=2e-2, atol=2e-2)
+
+
+def test_hist_scatter_exact():
+    bins, g, h = _rand_problem()
+    ghc = np.stack([g, h, np.ones_like(g)], axis=1)
+    ref = _np_hist(bins, ghc, 16)
+    out = np.asarray(H.hist_leaf(jnp.asarray(bins), jnp.asarray(ghc), 16, "scatter"))
+    np.testing.assert_allclose(out, ref, rtol=1e-5, atol=1e-4)
+
+
+@pytest.mark.parametrize("impl", ["scatter", "onehot"])
+def test_hist_per_leaf(impl):
+    bins, g, h = _rand_problem(n=300)
+    rng = np.random.RandomState(1)
+    leaf = rng.randint(0, 4, size=300).astype(np.int32)
+    ghc = np.stack([g, h, np.ones_like(g)], axis=1)
+    ref = np.zeros((4, 4, 16, 3))
+    for i in range(300):
+        for j in range(4):
+            ref[leaf[i], j, bins[i, j]] += ghc[i]
+    out = np.asarray(H.hist_per_leaf(jnp.asarray(bins), jnp.asarray(ghc),
+                                     jnp.asarray(leaf), 4, 16, impl))
+    np.testing.assert_allclose(out, ref, rtol=2e-2, atol=2e-2)
+
+
+def _np_best_split(hist, num_bins, na_bin, p: SplitParams):
+    """Brute-force reference for best_split (mirrors feature_histogram.hpp math)."""
+    f, b, _ = hist.shape
+    tg, th, tc = hist.sum(axis=(0, 1)) / f * f, None, None
+    tg = hist[0].sum(axis=0)  # parent from feature 0 (all features see same rows)
+    total = hist[0].sum(axis=0)
+
+    def gain1(g, h):
+        sg = np.sign(g) * max(abs(g) - p.lambda_l1, 0)
+        return sg * sg / (h + p.lambda_l2 + 1e-38)
+
+    best = (-np.inf, -1, -1, False)
+    parent_gain = gain1(total[0], total[1])
+    for j in range(f):
+        na = na_bin[j]
+        na_stats = hist[j, na] if na >= 0 else np.zeros(3)
+        for t in range(num_bins[j] - 1):
+            if t == na:
+                continue
+            left = hist[j, : t + 1].sum(axis=0)
+            if na >= 0 and na <= t:
+                left = left - na_stats
+            for dleft in ([False, True] if na >= 0 else [False]):
+                l = left + (na_stats if dleft else 0)
+                r = total - l
+                if l[2] < p.min_data_in_leaf or r[2] < p.min_data_in_leaf:
+                    continue
+                if l[1] < p.min_sum_hessian_in_leaf or r[1] < p.min_sum_hessian_in_leaf:
+                    continue
+                gain = gain1(l[0], l[1]) + gain1(r[0], r[1]) - parent_gain
+                if gain > best[0]:
+                    best = (gain, j, t, dleft)
+    return best
+
+
+@pytest.mark.parametrize("l1,l2,seed", [(0.0, 0.0, 0), (0.5, 1.0, 1), (0.0, 5.0, 2)])
+def test_best_split_matches_bruteforce(l1, l2, seed):
+    bins, g, h = _rand_problem(n=400, f=3, b=8, seed=seed)
+    ghc = np.stack([g, h, np.ones_like(g)], axis=1)
+    hist = _np_hist(bins, ghc, 8)
+    num_bins = np.array([8, 8, 8], dtype=np.int32)
+    na_bin = np.array([-1, 7, -1], dtype=np.int32)  # feature 1 has a missing bin
+    p = SplitParams(lambda_l1=l1, lambda_l2=l2, min_data_in_leaf=5,
+                    min_sum_hessian_in_leaf=1e-3)
+    ref_gain, ref_f, ref_t, ref_dl = _np_best_split(hist, num_bins, na_bin, p)
+    total = hist[0].sum(axis=0)
+    res = best_split(jnp.asarray(hist, dtype=jnp.float32), jnp.asarray(num_bins),
+                     jnp.asarray(np.where(na_bin < 0, 256, na_bin).astype(np.int32)),
+                     total[0], total[1], total[2],
+                     jnp.ones(3, dtype=bool), p, True)
+    assert abs(float(res.gain) - ref_gain) < 1e-2 * max(1.0, abs(ref_gain))
+    assert int(res.feature) == ref_f
+    assert int(res.bin) == ref_t
+
+
+def test_leaf_output_l1_l2():
+    p = SplitParams(lambda_l1=1.0, lambda_l2=2.0)
+    # w = -sign(g)*max(|g|-l1,0)/(h+l2)
+    assert abs(float(leaf_output(5.0, 3.0, p)) - (-(5 - 1) / (3 + 2))) < 1e-6
+    assert abs(float(leaf_output(-0.5, 3.0, p))) < 1e-6  # |g| < l1 -> 0
+
+
+def test_grow_tree_depth1_optimal():
+    """A single split must pick the brute-force best split."""
+    bins, g, h = _rand_problem(n=400, f=3, b=8, seed=3)
+    ghc = jnp.asarray(np.stack([g, h, np.ones_like(g)], axis=1))
+    num_bins = jnp.asarray(np.array([8, 8, 8], dtype=np.int32))
+    na_bin = jnp.asarray(np.array([256, 256, 256], dtype=np.int32))
+    p = SplitParams(min_data_in_leaf=5)
+    gp = GrowParams(num_leaves=2, max_bin=8, split=p, hist_impl="scatter")
+    tree, leaf_id = grow_tree(jnp.asarray(bins), ghc, num_bins, na_bin,
+                              jnp.ones(3, dtype=bool), gp)
+    hist = _np_hist(bins, np.asarray(ghc), 8)
+    ref_gain, ref_f, ref_t, _ = _np_best_split(
+        hist, np.array([8, 8, 8]), np.array([-1, -1, -1]), p)
+    assert int(tree.num_leaves) == 2
+    assert int(tree.split_feature[0]) == ref_f
+    assert int(tree.threshold_bin[0]) == ref_t
+    # partition consistency
+    lid = np.asarray(leaf_id)
+    go_right = bins[:, ref_f] > ref_t
+    assert np.all(lid[go_right] == 1)
+    assert np.all(lid[~go_right] == 0)
+    # leaf values = -G/(H+lambda) over each side
+    gl = np.asarray(ghc)[~go_right]
+    wl = -gl[:, 0].sum() / (gl[:, 1].sum() + 1e-38)
+    assert abs(float(tree.leaf_value[0]) - wl) < 1e-4
+
+
+def test_grow_tree_respects_num_leaves_and_count():
+    bins, g, h = _rand_problem(n=600, f=4, b=16, seed=4)
+    ghc = jnp.asarray(np.stack([g, h, np.ones_like(g)], axis=1))
+    num_bins = jnp.asarray(np.full(4, 16, dtype=np.int32))
+    na_bin = jnp.asarray(np.full(4, 256, dtype=np.int32))
+    gp = GrowParams(num_leaves=8, max_bin=16,
+                    split=SplitParams(min_data_in_leaf=10), hist_impl="scatter")
+    tree, leaf_id = grow_tree(jnp.asarray(bins), ghc, num_bins, na_bin,
+                              jnp.ones(4, dtype=bool), gp)
+    nl = int(tree.num_leaves)
+    assert 2 <= nl <= 8
+    lid = np.asarray(leaf_id)
+    assert set(np.unique(lid)) == set(range(nl))
+    # leaf counts match partition
+    for l in range(nl):
+        assert int(tree.leaf_count[l]) == int((lid == l).sum())
+    # min_data_in_leaf respected
+    assert np.bincount(lid).min() >= 10
+
+
+def test_grow_tree_max_depth():
+    bins, g, h = _rand_problem(n=600, f=4, b=16, seed=5)
+    ghc = jnp.asarray(np.stack([g, h, np.ones_like(g)], axis=1))
+    num_bins = jnp.asarray(np.full(4, 16, dtype=np.int32))
+    na_bin = jnp.asarray(np.full(4, 256, dtype=np.int32))
+    gp = GrowParams(num_leaves=31, max_depth=2, max_bin=16,
+                    split=SplitParams(min_data_in_leaf=1), hist_impl="scatter")
+    tree, _ = grow_tree(jnp.asarray(bins), ghc, num_bins, na_bin,
+                        jnp.ones(4, dtype=bool), gp)
+    assert int(tree.num_leaves) <= 4  # depth 2 -> at most 4 leaves
