@@ -16,6 +16,7 @@ from .gbdt import GBDT
 
 class GOSS(GBDT):
     name = "goss"
+    _needs_grad_for_bag = True
 
     def __init__(self, config, train_set, objective, metrics=None):
         super().__init__(config, train_set, objective, metrics)

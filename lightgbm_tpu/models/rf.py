@@ -16,6 +16,7 @@ from .gbdt import GBDT
 class RF(GBDT):
     name = "rf"
     average_output = True
+    _supports_fused = False
 
     def __init__(self, config, train_set, objective, metrics=None):
         if not (config.bagging_freq > 0 and

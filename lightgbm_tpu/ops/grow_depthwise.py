@@ -1,0 +1,242 @@
+"""Depthwise (level-wise) tree growing — the high-throughput TPU path.
+
+The leaf-wise grower (ops/grow.py) matches the reference's SerialTreeLearner
+semantics exactly but pays one full-data histogram pass per split: O(num_leaves)
+passes per tree. This grower does one pass per *level*: histograms for every node
+of a level are accumulated in a single MXU contraction whose output width is the
+(slot x channel) axis, so deep levels fill the systolic array instead of padding a
+3-wide output. The sibling-subtraction trick (reference:
+serial_tree_learner.cpp:315-355) measures only the smaller child of each split.
+
+Cost per tree: O(max_depth) histogram passes instead of O(num_leaves) — the same
+asymptotic win the reference gets from partition-ordered gradients, with no row
+reordering.
+
+The whole tree builds inside ONE jitted lax.scan over levels — zero host
+round-trips per tree (critical: device round-trips cost >100 ms on tunneled TPU
+runtimes). All level bookkeeping (budgeted split selection, node numbering, child
+pointers) is vectorized as masked [num_leaves]-sized scatters.
+
+Tree layout matches ops/grow.py: node t = t-th split (nodes within a level are
+numbered in leaf order), child pointers >= 0 internal / < 0 = ~leaf (reference
+encoding, tree.h:25).
+"""
+from __future__ import annotations
+
+import math
+from functools import partial
+from typing import NamedTuple, Tuple
+
+import jax
+import jax.numpy as jnp
+
+from . import histogram as H
+from .grow import GrowParams, TreeArrays, _empty_tree, _psum
+from .split import NEG_INF, SplitParams, best_split, leaf_output
+
+_OOB = 1 << 20  # out-of-bounds scatter index (dropped with mode="drop")
+
+
+class _DWState(NamedTuple):
+    leaf_id: jnp.ndarray      # [N]
+    hist: jnp.ndarray         # [L, F, B, 3] per-leaf histograms (frontier leaves)
+    leaf_g: jnp.ndarray       # [L]
+    leaf_h: jnp.ndarray
+    leaf_c: jnp.ndarray
+    active: jnp.ndarray       # [L] bool: frontier (may still split)
+    parent_node: jnp.ndarray  # [L] i32
+    parent_right: jnp.ndarray # [L] bool
+    tree: TreeArrays
+
+
+def _scatter_set(arr, idx, val, mask):
+    """arr[idx] = val where mask (vectorized, dropped where ~mask)."""
+    safe = jnp.where(mask, idx, _OOB)
+    return arr.at[safe].set(val, mode="drop")
+
+
+@partial(jax.jit, static_argnames=("gp",))
+def grow_tree_depthwise(bins: jnp.ndarray, ghc: jnp.ndarray,
+                        num_bins: jnp.ndarray, na_bin: jnp.ndarray,
+                        feature_mask: jnp.ndarray, gp: GrowParams
+                        ) -> Tuple[TreeArrays, jnp.ndarray]:
+    """Grow one tree level-wise. Same interface as ops.grow.grow_tree; under
+    shard_map with gp.axis_name set, histograms are psum-reduced (data-parallel)."""
+    n, f = bins.shape
+    L, B = gp.num_leaves, gp.max_bin
+    sp = gp.split
+    # unlimited depth => up to L-1 levels; the while_loop below exits as soon as
+    # a level selects no splits, so balanced trees still cost ~log2(L) passes
+    max_levels = gp.max_depth if gp.max_depth > 0 else max(1, L - 1)
+    SLOTS = (L + 1) // 2 + 1 if L > 2 else 2  # max splits in one level
+
+    hist0 = _psum(H.hist_leaf(bins, ghc, B, gp.hist_impl), gp)
+    g0 = hist0[0, :, 0].sum()
+    h0 = hist0[0, :, 1].sum()
+    c0 = hist0[0, :, 2].sum()
+
+    state = _DWState(
+        leaf_id=jnp.zeros(n, dtype=jnp.int32),
+        hist=jnp.zeros((L, f, B, 3), jnp.float32).at[0].set(hist0),
+        leaf_g=jnp.zeros(L).at[0].set(g0),
+        leaf_h=jnp.zeros(L).at[0].set(h0),
+        leaf_c=jnp.zeros(L).at[0].set(c0),
+        active=jnp.zeros(L, bool).at[0].set(True),
+        parent_node=jnp.full(L, -1, jnp.int32),
+        parent_right=jnp.zeros(L, bool),
+        tree=_empty_tree(L),
+    )
+    # root leaf value (kept if nothing splits)
+    root_w = leaf_output(g0, h0, sp)
+    state = state._replace(tree=state.tree._replace(
+        leaf_value=state.tree.leaf_value.at[0].set(root_w),
+        leaf_weight=state.tree.leaf_weight.at[0].set(h0),
+        leaf_count=state.tree.leaf_count.at[0].set(c0)))
+
+    leaves_iota = jnp.arange(L, dtype=jnp.int32)
+
+    def level(st: _DWState):
+        # ---- best split for every frontier leaf (vectorized over L) ----
+        res = jax.vmap(lambda hh, g_, h_, c_, a_: best_split(
+            hh, num_bins, na_bin, g_, h_, c_, feature_mask, sp, a_)
+        )(st.hist, st.leaf_g, st.leaf_h, st.leaf_c, st.active)
+
+        # ---- budgeted selection (num_leaves cap): top-gain candidates win ----
+        cand = st.active & (res.gain > jnp.maximum(sp.min_gain_to_split, 0.0)) \
+            & (res.gain > NEG_INF / 2)
+        budget = L - st.tree.num_leaves
+        key = jnp.where(cand, res.gain, -jnp.inf)
+        order = jnp.argsort(-key)
+        rank = jnp.zeros(L, jnp.int32).at[order].set(leaves_iota)
+        sel = cand & (rank < budget)
+        num_sel = sel.sum().astype(jnp.int32)
+
+        # assignment order within the level: by leaf index
+        idx_in_lvl = (jnp.cumsum(sel.astype(jnp.int32)) - 1).astype(jnp.int32)
+        node_id = st.tree.num_leaves - 1 + idx_in_lvl      # node_cnt == n_leaves-1
+        new_leaf = st.tree.num_leaves + idx_in_lvl
+
+        feat, thr, dleft = res.feature, res.bin, res.default_left
+        lg, lh, lc = res.left_g, res.left_h, res.left_cnt
+        rg, rh, rc = st.leaf_g - lg, st.leaf_h - lh, st.leaf_c - lc
+
+        # ---- tree arrays (masked scatters over node/leaf ids) ----
+        tr = st.tree
+        w_l = leaf_output(lg, lh, sp)
+        w_r = leaf_output(rg, rh, sp)
+        w_p = leaf_output(st.leaf_g, st.leaf_h, sp)
+        # parent child-pointer fixup
+        has_par = sel & (st.parent_node >= 0)
+        lc_arr = _scatter_set(tr.left_child, st.parent_node,
+                              node_id, has_par & ~st.parent_right)
+        rc_arr = _scatter_set(tr.right_child, st.parent_node,
+                              node_id, has_par & st.parent_right)
+        tr = TreeArrays(
+            split_feature=_scatter_set(tr.split_feature, node_id, feat, sel),
+            threshold_bin=_scatter_set(tr.threshold_bin, node_id, thr, sel),
+            default_left=_scatter_set(tr.default_left, node_id, dleft, sel),
+            left_child=_scatter_set(lc_arr, node_id, ~leaves_iota, sel),
+            right_child=_scatter_set(rc_arr, node_id, ~new_leaf, sel),
+            split_gain=_scatter_set(tr.split_gain, node_id,
+                                    res.gain.astype(jnp.float32), sel),
+            leaf_value=_scatter_set(
+                _scatter_set(tr.leaf_value, leaves_iota, w_l, sel),
+                new_leaf, w_r, sel),
+            leaf_weight=_scatter_set(
+                _scatter_set(tr.leaf_weight, leaves_iota, lh, sel),
+                new_leaf, rh, sel),
+            leaf_count=_scatter_set(
+                _scatter_set(tr.leaf_count, leaves_iota, lc, sel),
+                new_leaf, rc, sel),
+            internal_value=_scatter_set(tr.internal_value, node_id, w_p, sel),
+            internal_weight=_scatter_set(tr.internal_weight, node_id,
+                                         st.leaf_h, sel),
+            internal_count=_scatter_set(tr.internal_count, node_id,
+                                        st.leaf_c, sel),
+            num_leaves=tr.num_leaves + num_sel,
+        )
+
+        # ---- apply all level splits to leaf_id in one pass ----
+        # All per-leaf lookups are packed into ONE [L, 6] table so each row costs a
+        # single gather (row-granularity gathers are ~5 ms/1M rows on TPU; doing
+        # five of them per level dominated the grower before this packing).
+        small_is_left = lc <= rc
+        # slot for rows that go right and the right child is the smaller one
+        slot_right = jnp.where(sel & ~small_is_left, idx_in_lvl, SLOTS)
+        # slot for rows that stay left and the left child is the smaller one
+        slot_left = jnp.where(sel & small_is_left, idx_in_lvl, SLOTS)
+        table = jnp.stack([
+            jnp.where(sel, feat, -1),                       # 0: split feature
+            thr,                                            # 1: threshold bin
+            dleft.astype(jnp.int32),                        # 2: default left
+            new_leaf,                                       # 3: right-child leaf id
+            slot_left,                                      # 4: hist slot if left
+            slot_right,                                     # 5: hist slot if right
+        ], axis=1)                                          # [L, 6]
+
+        rid = st.leaf_id
+        row = table[rid]                                    # [N, 6] single gather
+        fr = row[:, 0]
+        has_split = fr >= 0
+        # bins column + its na-bin via one-hot select (no per-row column gather)
+        fm = fr[:, None] == jnp.arange(f, dtype=jnp.int32)[None, :]   # [N, F]
+        col = jnp.sum(jnp.where(fm, bins.astype(jnp.int32), 0), axis=1)
+        na_sel = jnp.sum(jnp.where(fm, na_bin[None, :], 0), axis=1)
+        is_na = col == na_sel
+        go_right = jnp.where(is_na, row[:, 2] == 0, col > row[:, 1])
+        leaf_id2 = jnp.where(has_split & go_right, row[:, 3], rid)
+
+        # ---- smaller-child histograms: one pass, slot-indexed ----
+        slot_id = jnp.where(has_split,
+                            jnp.where(go_right, row[:, 5], row[:, 4]),
+                            jnp.int32(SLOTS))
+        hist_small = _psum(
+            H.hist_per_leaf(bins, ghc, slot_id, SLOTS, B, gp.hist_impl), gp)
+
+        leaf_of_slot = _scatter_set(jnp.full(SLOTS, _OOB, jnp.int32),
+                                    idx_in_lvl, leaves_iota, sel)
+        slot_used = leaf_of_slot < L
+        parent_hist = st.hist[jnp.minimum(leaf_of_slot, L - 1)]  # [SLOTS,...]
+        hist_sib = parent_hist - hist_small
+        sl = small_is_left[jnp.minimum(leaf_of_slot, L - 1)][:, None, None, None]
+        hist_left = jnp.where(sl, hist_small, hist_sib)
+        hist_right = jnp.where(sl, hist_sib, hist_small)
+        new_leaf_of_slot = _scatter_set(jnp.full(SLOTS, _OOB, jnp.int32),
+                                        idx_in_lvl, new_leaf, sel)
+        hist2 = st.hist.at[jnp.where(slot_used, leaf_of_slot, _OOB)].set(
+            hist_left, mode="drop")
+        hist2 = hist2.at[jnp.where(slot_used, new_leaf_of_slot, _OOB)].set(
+            hist_right, mode="drop")
+
+        # ---- per-leaf stats / frontier update ----
+        leaf_g2 = _scatter_set(_scatter_set(st.leaf_g, leaves_iota, lg, sel),
+                               new_leaf, rg, sel)
+        leaf_h2 = _scatter_set(_scatter_set(st.leaf_h, leaves_iota, lh, sel),
+                               new_leaf, rh, sel)
+        leaf_c2 = _scatter_set(_scatter_set(st.leaf_c, leaves_iota, lc, sel),
+                               new_leaf, rc, sel)
+        active2 = _scatter_set(sel, new_leaf, jnp.ones(L, bool), sel)
+        pn2 = _scatter_set(_scatter_set(st.parent_node, leaves_iota, node_id, sel),
+                           new_leaf, node_id, sel)
+        pr2 = _scatter_set(
+            _scatter_set(st.parent_right, leaves_iota, jnp.zeros(L, bool), sel),
+            new_leaf, jnp.ones(L, bool), sel)
+
+        return _DWState(
+            leaf_id=leaf_id2, hist=hist2, leaf_g=leaf_g2, leaf_h=leaf_h2,
+            leaf_c=leaf_c2, active=active2, parent_node=pn2, parent_right=pr2,
+            tree=tr,
+        ), num_sel
+
+    def cond(carry):
+        st, lvl, last_sel = carry
+        return (lvl < max_levels) & (last_sel > 0)
+
+    def body(carry):
+        st, lvl, _ = carry
+        st2, num_sel = level(st)
+        return st2, lvl + 1, num_sel
+
+    state, _, _ = jax.lax.while_loop(
+        cond, body, (state, jnp.int32(0), jnp.int32(1)))
+    return state.tree, state.leaf_id

@@ -23,11 +23,14 @@ from .mesh import DATA_AXIS
 
 
 def grow_tree_dp(bins, ghc, num_bins, na_bin, feature_mask,
-                 gp: GrowParams, mesh: Mesh) -> Tuple[TreeArrays, jnp.ndarray]:
+                 gp: GrowParams, mesh: Mesh,
+                 grow_fn=grow_tree) -> Tuple[TreeArrays, jnp.ndarray]:
     """Grow one tree with rows sharded over ``mesh``'s data axis.
 
-    bins/ghc must already be sharded along rows (or will be resharded here);
-    the returned TreeArrays are replicated, leaf_id stays row-sharded.
+    ``grow_fn`` is either ops.grow.grow_tree (leaf-wise) or
+    ops.grow_depthwise.grow_tree_depthwise (level-wise) — both psum their
+    histograms when gp.axis_name is set. bins/ghc must already be sharded along
+    rows; the returned TreeArrays are replicated, leaf_id stays row-sharded.
     """
     axis = mesh.axis_names[0]
     gp_dp = gp if gp.axis_name == axis else \
@@ -36,7 +39,7 @@ def grow_tree_dp(bins, ghc, num_bins, na_bin, feature_mask,
                    axis_name=axis)
 
     fn = jax.shard_map(
-        partial(grow_tree, gp=gp_dp),
+        partial(grow_fn, gp=gp_dp),
         mesh=mesh,
         in_specs=(P(axis, None), P(axis, None), P(), P(), P()),
         out_specs=(TreeArrays(*([P()] * len(TreeArrays._fields))), P(axis)),
