@@ -378,7 +378,7 @@ class Booster:
         else:
             raw = np.zeros((n, k))
             for cls in range(k):
-                cls_trees = trees[cls::k] if False else [trees[i] for i in range(cls, len(trees), k)]
+                cls_trees = trees[cls::k]
                 stack_c = stack_trees(cls_trees, x.shape[1], 256)
                 stack_dev = {kk: jnp.asarray(v) for kk, v in stack_c.items()}
                 ms = max(int(stack_c["num_leaves"].max()) - 1, 1)

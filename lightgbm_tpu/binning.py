@@ -107,6 +107,8 @@ class BinMapper:
         n_avail = max_bin - (1 if missing_type == MISSING_NAN else 0)
         bounds = BinMapper._find_numerical_bounds(
             nonzero, zero_cnt, n_avail, min_data_in_bin, forced_bounds=forced_bounds)
+        assert len(bounds) <= n_avail, \
+            f"bin finding produced {len(bounds)} bounds > budget {n_avail}"
         num_bins = len(bounds)
         if missing_type == MISSING_NAN:
             bounds = np.append(bounds, np.nan)
@@ -151,36 +153,58 @@ class BinMapper:
             fb = np.unique(np.asarray(sorted(forced_bounds), dtype=np.float64))
             fb = fb[: max(1, max_bin - 1)]
             return np.append(fb, np.inf)
+        # reserve slots up front for the +/-kZeroThreshold boundaries that
+        # _fix_zero_boundary will add, so the final count never exceeds max_bin
+        reserve = 0
+        if zero_cnt > 0:
+            reserve = int(np.any(nonzero < -K_ZERO_THRESHOLD)) \
+                + int(np.any(nonzero > K_ZERO_THRESHOLD))
+        budget = max(1, max_bin - reserve)
         distinct, counts = np.unique(nonzero, return_counts=True)
         if zero_cnt > 0:
             pos = np.searchsorted(distinct, 0.0)
             distinct = np.insert(distinct, pos, 0.0)
             counts = np.insert(counts, pos, zero_cnt)
-        if len(distinct) <= max(1, max_bin):
+        if len(distinct) <= max(1, budget):
             # every distinct value gets a bin; bounds midway between neighbors
             if len(distinct) == 1:
                 return np.array([np.inf])
             mids = (distinct[:-1] + distinct[1:]) / 2.0
             # keep zero isolated from neighbors
             bounds = np.append(mids, np.inf)
-            return BinMapper._fix_zero_boundary(bounds, distinct)
-        # equal-frequency greedy: walk distinct values accumulating counts until the
-        # per-bin budget is met (reference: GreedyFindBin in src/io/bin.cpp — ours is a
-        # fresh weighted-quantile formulation, not a translation)
-        total = counts.sum()
-        n_bins = max(1, min(max_bin, int(total // max(1, min_data_in_bin)) or 1))
-        target = total / n_bins
-        bounds_list: List[float] = []
-        acc = 0.0
-        for i in range(len(distinct) - 1):
-            acc += counts[i]
-            if acc >= target - 1e-9 and len(bounds_list) < n_bins - 1:
-                bounds_list.append((distinct[i] + distinct[i + 1]) / 2.0)
-                acc = 0.0
-        bounds = np.array(bounds_list + [np.inf])
-        bounds = np.unique(bounds)
-        if zero_cnt > 0:
             bounds = BinMapper._fix_zero_boundary(bounds, distinct)
+        else:
+            # equal-frequency greedy: walk distinct values accumulating counts until
+            # the per-bin budget is met (reference: GreedyFindBin in src/io/bin.cpp —
+            # ours is a fresh weighted-quantile formulation, not a translation)
+            total = counts.sum()
+            n_bins = max(1, min(budget, int(total // max(1, min_data_in_bin)) or 1))
+            target = total / n_bins
+            bounds_list: List[float] = []
+            acc = 0.0
+            for i in range(len(distinct) - 1):
+                acc += counts[i]
+                if acc >= target - 1e-9 and len(bounds_list) < n_bins - 1:
+                    bounds_list.append((distinct[i] + distinct[i + 1]) / 2.0)
+                    acc = 0.0
+            bounds = np.unique(np.array(bounds_list + [np.inf]))
+            if zero_cnt > 0:
+                bounds = BinMapper._fix_zero_boundary(bounds, distinct)
+        # hard cap (safety net): merge top bins if the zero fix still overflowed
+        if len(bounds) > max_bin:
+            drop_n = len(bounds) - max_bin
+            protected = np.isinf(bounds) | (np.abs(bounds) <= K_ZERO_THRESHOLD)
+            unprot = np.where(~protected)[0]
+            keep = np.ones(len(bounds), dtype=bool)
+            if len(unprot) >= drop_n:
+                keep[unprot[-drop_n:]] = False
+            else:
+                # tiny max_bin: zero isolation is best-effort — give up the
+                # +/-kZeroThreshold bounds before the final +inf
+                keep[unprot] = False
+                zero_prot = np.where(protected & ~np.isinf(bounds))[0]
+                keep[zero_prot[: drop_n - len(unprot)]] = False
+            bounds = bounds[keep]
         return bounds
 
     @staticmethod

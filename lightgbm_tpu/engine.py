@@ -54,9 +54,6 @@ def train(params: Dict[str, Any], train_set: Dataset,
     valid_names = valid_names or []
     for i, vs in enumerate(valid_sets):
         if vs is train_set:
-            name = valid_names[i] if i < len(valid_names) else "training"
-            booster._gbdt.metrics = booster._gbdt.metrics  # training eval flag below
-            _train_as_valid = True
             booster._eval_training = True
             continue
         name = valid_names[i] if i < len(valid_names) else f"valid_{i}"

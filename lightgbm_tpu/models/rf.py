@@ -54,9 +54,7 @@ class RF(GBDT):
         t = self.iter_ + 1  # trees per class after this one
         delta = tree_dev.leaf_value[leaf_id]
         if k == 1:
-            self.train_score = (self.train_score * max(t - 1, 0) + self._const_score * 0
-                                + delta + (self.train_score * 0)) / t \
-                if t == 1 else (self.train_score * (t - 1) + delta) / t
+            self.train_score = (self.train_score * (t - 1) + delta) / t
         else:
             prev = self.train_score[:, cls] * (t - 1)
             self.train_score = self.train_score.at[:, cls].set((prev + delta) / t)

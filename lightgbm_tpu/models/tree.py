@@ -193,8 +193,7 @@ class Tree:
             s = kv.get(key, "")
             if not s:
                 return np.zeros(size, dtype=dtype)
-            return np.fromstring(s, dtype=dtype, sep=" ") if False else \
-                np.array(s.split(" "), dtype=dtype)
+            return np.array(s.split(" "), dtype=dtype)
 
         n_int = max(nl - 1, 0)
         dt = arr("decision_type", np.int32, n_int)

@@ -40,6 +40,18 @@ def _pad_rows(x: jnp.ndarray, mult: int):
     return x
 
 
+def _split_hi_lo(ghc: jnp.ndarray) -> jnp.ndarray:
+    """Split f32 channels into bf16 (hi, lo) pairs: ``[N, C] -> [N, 2C]`` bf16.
+
+    The MXU runs bf16 natively; multiplying a bf16 value by an exact {0,1}
+    one-hot and accumulating in f32 loses nothing, so hi+lo recovers ~f32
+    accuracy (the reference accumulates f64 pairs, bin.h:32-34; GPU docs show
+    f32 suffices, docs/GPU-Performance.rst:129-137 — bf16 alone does not)."""
+    hi = ghc.astype(jnp.bfloat16)
+    lo = (ghc - hi.astype(jnp.float32)).astype(jnp.bfloat16)
+    return jnp.concatenate([hi, lo], axis=-1)
+
+
 def hist_leaf_onehot(bins: jnp.ndarray, ghc: jnp.ndarray, num_bins: int,
                      tile: int = _DEF_TILE, acc_dtype=jnp.float32) -> jnp.ndarray:
     """Histogram of one row-subset: ``bins`` [N, F] uint8, ``ghc`` [N, 3] f32
@@ -54,7 +66,7 @@ def hist_leaf_onehot(bins: jnp.ndarray, ghc: jnp.ndarray, num_bins: int,
     ghc = _pad_rows(ghc, tile)
     n_tiles = bins.shape[0] // tile
     bins_t = bins.reshape(n_tiles, tile, f)
-    ghc_t = ghc.reshape(n_tiles, tile, 3)
+    ghc_t = _split_hi_lo(ghc).reshape(n_tiles, tile, 6)
     iota = jnp.arange(b, dtype=jnp.int32)
 
     def step(carry, xs):
@@ -62,13 +74,14 @@ def hist_leaf_onehot(bins: jnp.ndarray, ghc: jnp.ndarray, num_bins: int,
         onehot = (bt.astype(jnp.int32)[:, :, None] == iota).astype(jnp.bfloat16)
         onehot = onehot.reshape(tile, f * b)
         part = jax.lax.dot_general(
-            onehot, gt.astype(jnp.bfloat16),
+            onehot, gt,
             dimension_numbers=(((0,), (0,)), ((), ())),
             preferred_element_type=acc_dtype)
         return carry + part, None
 
-    init = jnp.zeros((f * b, 3), dtype=acc_dtype)
+    init = jnp.zeros((f * b, 6), dtype=acc_dtype)
     hist, _ = jax.lax.scan(step, init, (bins_t, ghc_t))
+    hist = hist[:, :3] + hist[:, 3:]
     return hist.reshape(f, b, 3).astype(jnp.float32)
 
 
@@ -100,7 +113,7 @@ def hist_per_leaf_onehot(bins: jnp.ndarray, ghc: jnp.ndarray, leaf_id: jnp.ndarr
     leaf_id = jnp.pad(leaf_id, (0, bins.shape[0] - n), constant_values=l)
     n_tiles = bins.shape[0] // tile
     bins_t = bins.reshape(n_tiles, tile, f)
-    ghc_t = ghc.reshape(n_tiles, tile, 3)
+    ghc_t = _split_hi_lo(ghc).reshape(n_tiles, tile, 6)
     lid_t = leaf_id.reshape(n_tiles, tile)
     iota_b = jnp.arange(b, dtype=jnp.int32)
     iota_l = jnp.arange(l, dtype=jnp.int32)
@@ -110,16 +123,16 @@ def hist_per_leaf_onehot(bins: jnp.ndarray, ghc: jnp.ndarray, leaf_id: jnp.ndarr
         onehot_b = (bt.astype(jnp.int32)[:, :, None] == iota_b).astype(jnp.bfloat16)
         onehot_b = onehot_b.reshape(tile, f * b)
         onehot_l = (lt[:, None] == iota_l).astype(jnp.bfloat16)          # [T, L]
-        w = onehot_l[:, :, None] * gt.astype(jnp.bfloat16)[:, None, :]   # [T, L, 3]
+        w = onehot_l[:, :, None] * gt[:, None, :]                        # [T, L, 6]
         part = jax.lax.dot_general(
-            onehot_b, w.reshape(tile, l * 3),
+            onehot_b, w.reshape(tile, l * 6),
             dimension_numbers=(((0,), (0,)), ((), ())),
-            preferred_element_type=acc_dtype)                            # [F*B, L*3]
+            preferred_element_type=acc_dtype)                            # [F*B, L*6]
         return carry + part, None
 
-    init = jnp.zeros((f * b, l * 3), dtype=acc_dtype)
+    init = jnp.zeros((f * b, l * 6), dtype=acc_dtype)
     hist, _ = jax.lax.scan(step, init, (bins_t, ghc_t, lid_t))
-    hist = hist.reshape(f, b, l, 3).transpose(2, 0, 1, 3)
+    hist = hist.reshape(f, b, l, 2, 3).sum(axis=3).transpose(2, 0, 1, 3)
     return hist.astype(jnp.float32)
 
 

@@ -114,3 +114,31 @@ def test_equal_freq_binning():
     counts = np.bincount(b, minlength=m.num_bins)
     # roughly equal frequency: no bin more than 4x the ideal share
     assert counts.max() < 4 * len(v) / m.num_bins
+
+
+def test_zero_boundary_never_overflows_max_bin():
+    """ADVICE r1 high: a standard-normal feature plus exact zeros used to produce
+    257 bins at max_bin=255 (the +/-kZeroThreshold fix-up pushed past the cap)."""
+    from lightgbm_tpu.binning import BinMapper
+
+    rng = np.random.RandomState(0)
+    vals = rng.randn(20000)
+    vals[::7] = 0.0  # exact zeros among both-sign values
+    for max_bin in (255, 63, 16, 4):
+        m = BinMapper.from_sample(vals, len(vals), max_bin)
+        assert m.num_bins <= max_bin, (max_bin, m.num_bins)
+        # zero still isolated in its own bin
+        zb = m._value_to_bin_scalar(0.0)
+        assert m._value_to_bin_scalar(vals[np.abs(vals) > 0.2].min()) != zb
+
+
+def test_zero_boundary_overflow_with_nan():
+    from lightgbm_tpu.binning import BinMapper
+
+    rng = np.random.RandomState(1)
+    vals = rng.randn(20000)
+    vals[::7] = 0.0
+    vals[::11] = np.nan
+    for max_bin in (255, 63, 16, 4):
+        m = BinMapper.from_sample(vals, len(vals), max_bin)
+        assert m.num_bins <= max_bin, (max_bin, m.num_bins)

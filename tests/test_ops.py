@@ -29,11 +29,14 @@ def _np_hist(bins, ghc, b):
 
 @pytest.mark.parametrize("impl", ["scatter", "onehot"])
 def test_hist_leaf_matches_numpy(impl):
+    # the onehot path splits grad/hess into bf16 hi+lo components, so it must be
+    # accurate to ~f32 (the old bf16-value cast needed rtol=2e-2 — a numerics bug,
+    # VERDICT r1 weak #3)
     bins, g, h = _rand_problem()
     ghc = np.stack([g, h, np.ones_like(g)], axis=1)
     ref = _np_hist(bins, ghc, 16)
     out = np.asarray(H.hist_leaf(jnp.asarray(bins), jnp.asarray(ghc), 16, impl))
-    np.testing.assert_allclose(out, ref, rtol=2e-2, atol=2e-2)
+    np.testing.assert_allclose(out, ref, rtol=1e-4, atol=1e-4)
 
 
 def test_hist_scatter_exact():
@@ -56,7 +59,7 @@ def test_hist_per_leaf(impl):
             ref[leaf[i], j, bins[i, j]] += ghc[i]
     out = np.asarray(H.hist_per_leaf(jnp.asarray(bins), jnp.asarray(ghc),
                                      jnp.asarray(leaf), 4, 16, impl))
-    np.testing.assert_allclose(out, ref, rtol=2e-2, atol=2e-2)
+    np.testing.assert_allclose(out, ref, rtol=1e-4, atol=1e-4)
 
 
 def _np_best_split(hist, num_bins, na_bin, p: SplitParams):
