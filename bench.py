@@ -55,8 +55,8 @@ def main():
         "metric": "auc",
     }
     t0 = time.time()
-    ds = lgb.Dataset(X, label=y)
-    ds.construct()
+    ds = lgb.Dataset(X, label=y, params=params)  # params BEFORE construct: max_bin
+    ds.construct()                               # must reach the bin finder
     t_bin = time.time() - t0
 
     booster = lgb.Booster(params=params, train_set=ds)

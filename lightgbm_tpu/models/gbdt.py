@@ -210,9 +210,9 @@ class GBDT:
             for cls in range(k):
                 g = grad if k == 1 else grad[:, cls]
                 h = hess if k == 1 else hess[:, cls]
-                ghc = jnp.stack([g * bag_mask, h * bag_mask,
-                                 (bag_mask > 0).astype(g.dtype)], axis=1)
-                tree, leaf_id = grow_fn(bins, ghc, num_bins, na_bin, fmask, gp)
+                tree, leaf_id = grow_fn(bins, g * bag_mask, h * bag_mask,
+                                        (bag_mask > 0).astype(g.dtype),
+                                        num_bins, na_bin, fmask, gp)
                 if obj is not None:
                     s_cls = new_score if k == 1 else new_score[:, cls]
                     renewed = obj.renew_leaf_values(s_cls, leaf_id, gp.num_leaves)
@@ -322,32 +322,33 @@ class GBDT:
         for cls in range(k):
             g = grad if k == 1 else grad[:, cls]
             h = hess if k == 1 else hess[:, cls]
-            ghc = self._make_ghc(g, h)
+            gw, hw, cw = self._make_ghc(g, h)
             depthwise = self.config.grow_policy == "depthwise"
             if self._dp:
                 from ..parallel.data_parallel import grow_tree_dp
                 from ..parallel.mesh import shard_rows
                 if self._pad_rows:
-                    ghc = jnp.pad(ghc, ((0, self._pad_rows), (0, 0)))
-                ghc = shard_rows(ghc, self._mesh)
+                    gw = jnp.pad(gw, (0, self._pad_rows))
+                    hw = jnp.pad(hw, (0, self._pad_rows))
+                    cw = jnp.pad(cw, (0, self._pad_rows))
+                gw, hw, cw = (shard_rows(x, self._mesh) for x in (gw, hw, cw))
+                grow_fn = grow_tree
                 if depthwise:
                     from ..ops.grow_depthwise import grow_tree_depthwise
-                    tree_dev, leaf_id = grow_tree_dp(
-                        self._bins_dp, ghc, ts.num_bins_dev, ts.na_bin_dev,
-                        fmask, self.gp, self._mesh,
-                        grow_fn=grow_tree_depthwise)
-                else:
-                    tree_dev, leaf_id = grow_tree_dp(
-                        self._bins_dp, ghc, ts.num_bins_dev, ts.na_bin_dev,
-                        fmask, self.gp, self._mesh)
+                    grow_fn = grow_tree_depthwise
+                tree_dev, leaf_id = grow_tree_dp(
+                    self._bins_dp, gw, hw, cw, ts.num_bins_dev, ts.na_bin_dev,
+                    fmask, self.gp, self._mesh, grow_fn=grow_fn)
                 leaf_id = leaf_id[: self._n_orig]
             elif depthwise:
                 from ..ops.grow_depthwise import grow_tree_depthwise
                 tree_dev, leaf_id = grow_tree_depthwise(
-                    ts.bins, ghc, ts.num_bins_dev, ts.na_bin_dev, fmask, self.gp)
+                    ts.bins, gw, hw, cw, ts.num_bins_dev, ts.na_bin_dev,
+                    fmask, self.gp)
             else:
-                tree_dev, leaf_id = grow_tree(ts.bins, ghc, ts.num_bins_dev,
-                                              ts.na_bin_dev, fmask, self.gp)
+                tree_dev, leaf_id = grow_tree(ts.bins, gw, hw, cw,
+                                              ts.num_bins_dev, ts.na_bin_dev,
+                                              fmask, self.gp)
             tree_dev = self._finish_tree(tree_dev, leaf_id, cls)
             self.models_dev.append(tree_dev)
             self._update_scores(tree_dev, leaf_id, cls)
@@ -355,12 +356,14 @@ class GBDT:
                 any_split = True
         return not any_split
 
-    def _make_ghc(self, g, h) -> jnp.ndarray:
-        # objectives already folded sample weights into g/h; cnt channel = bag mask
+    def _make_ghc(self, g, h) -> Tuple[jnp.ndarray, jnp.ndarray, jnp.ndarray]:
+        # objectives already folded sample weights into g/h; cnt channel = bag
+        # mask. Channels stay separate 1-D arrays ([N, 3] tiles with 42x lane
+        # padding on TPU).
         if self._bag_mask is not None:
             m = self._bag_mask
-            return jnp.stack([g * m, h * m, m], axis=1)
-        return jnp.stack([g, h, jnp.ones_like(g)], axis=1)
+            return g * m, h * m, m
+        return g, h, jnp.ones_like(g)
 
     def _finish_tree(self, tree_dev: TreeArrays, leaf_id, cls: int) -> TreeArrays:
         """Leaf renewal (L1-family), shrinkage, first-iteration bias folding

@@ -104,15 +104,17 @@ def _allow_depth(depth, gp: GrowParams):
 
 
 @partial(jax.jit, static_argnames=("gp",))
-def grow_tree(bins: jnp.ndarray, ghc: jnp.ndarray,
+def grow_tree(bins: jnp.ndarray, g: jnp.ndarray, h: jnp.ndarray, c: jnp.ndarray,
               num_bins: jnp.ndarray, na_bin: jnp.ndarray,
               feature_mask: jnp.ndarray, gp: GrowParams
               ) -> Tuple[TreeArrays, jnp.ndarray]:
     """Grow one tree.
 
-    bins: [N, F] uint8; ghc: [N, 3] f32 (grad, hess, in-bag mask) — bagging is
-    mask-based (reference uses index subsets, gbdt.cpp:160-276; masks keep shapes
-    static on TPU); feature_mask: [F] bool (per-tree feature_fraction sample).
+    bins: [N, F] uint8; g/h/c: [N] f32 grad/hess/in-bag-count channels (already
+    bag-masked) — bagging is mask-based (reference uses index subsets,
+    gbdt.cpp:160-276; masks keep shapes static on TPU), and the channels are
+    separate 1-D arrays because an [N, 3] array tiles with 42x lane padding on
+    TPU; feature_mask: [F] bool (per-tree feature_fraction sample).
 
     Returns (TreeArrays, leaf_id [N] i32). leaf_id routes *all* rows (including
     out-of-bag) so the caller can update train scores by a single gather.
@@ -122,7 +124,7 @@ def grow_tree(bins: jnp.ndarray, ghc: jnp.ndarray,
     sp = gp.split
 
     leaf_id = jnp.zeros(n, dtype=jnp.int32)
-    hist0 = _psum(H.hist_leaf(bins, ghc, B, gp.hist_impl), gp)         # [F, B, 3]
+    hist0 = _psum(H.hist_leaf(bins, g, h, c, B, gp.hist_impl), gp)     # [F, B, 3]
     g0, h0, c0 = hist0[0, :, 0].sum(), hist0[0, :, 1].sum(), hist0[0, :, 2].sum()
 
     best0 = best_split(hist0, num_bins, na_bin, g0, h0, c0, feature_mask, sp,
@@ -175,9 +177,10 @@ def grow_tree(bins: jnp.ndarray, ghc: jnp.ndarray,
             # ---- smaller-child histogram + sibling by subtraction ----
             small_is_left = lc <= rc
             small_leaf = jnp.where(small_is_left, l, new_leaf)
-            mask = (leaf_id2 == small_leaf)
-            ghc_small = ghc * mask[:, None].astype(ghc.dtype)
-            hist_small = _psum(H.hist_leaf(bins, ghc_small, B, gp.hist_impl), gp)
+            mask = (leaf_id2 == small_leaf).astype(g.dtype)
+            hist_small = _psum(
+                H.hist_leaf(bins, g * mask, h * mask, c * mask, B, gp.hist_impl),
+                gp)
             hist_parent = st.hist[l]
             hist_large = hist_parent - hist_small
             hist_left = jnp.where(small_is_left, hist_small, hist_large)

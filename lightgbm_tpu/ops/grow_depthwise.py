@@ -2,20 +2,22 @@
 
 The leaf-wise grower (ops/grow.py) matches the reference's SerialTreeLearner
 semantics exactly but pays one full-data histogram pass per split: O(num_leaves)
-passes per tree. This grower does one pass per *level*: histograms for every node
-of a level are accumulated in a single MXU contraction whose output width is the
-(slot x channel) axis, so deep levels fill the systolic array instead of padding a
-3-wide output. The sibling-subtraction trick (reference:
+passes per tree. This grower does one pass per *level*: routing and histogram
+accumulation for every node of a level happen in a single fused scan over the
+data (ops/histogram.py hist_routed), whose MXU contraction width is the
+(slot x channel) axis. The sibling-subtraction trick (reference:
 serial_tree_learner.cpp:315-355) measures only the smaller child of each split.
 
-Cost per tree: O(max_depth) histogram passes instead of O(num_leaves) — the same
+Cost per tree: O(max_depth) data passes instead of O(num_leaves) — the same
 asymptotic win the reference gets from partition-ordered gradients, with no row
-reordering.
+reordering. Early levels are Python-unrolled with growing static slot counts
+(level k splits at most 2^k leaves) so they don't pay the deepest level's
+histogram width; a while_loop tail covers unbalanced growth past the unroll.
 
-The whole tree builds inside ONE jitted lax.scan over levels — zero host
-round-trips per tree (critical: device round-trips cost >100 ms on tunneled TPU
-runtimes). All level bookkeeping (budgeted split selection, node numbering, child
-pointers) is vectorized as masked [num_leaves]-sized scatters.
+The whole tree builds inside ONE jitted program — zero host round-trips per
+tree (critical: device round-trips cost >50 ms on tunneled TPU runtimes). All
+level bookkeeping (budgeted split selection, node numbering, child pointers) is
+vectorized as masked [num_leaves]-sized scatters.
 
 Tree layout matches ops/grow.py: node t = t-th split (nodes within a level are
 numbered in leaf order), child pointers >= 0 internal / < 0 = ~leaf (reference
@@ -56,21 +58,25 @@ def _scatter_set(arr, idx, val, mask):
 
 
 @partial(jax.jit, static_argnames=("gp",))
-def grow_tree_depthwise(bins: jnp.ndarray, ghc: jnp.ndarray,
-                        num_bins: jnp.ndarray, na_bin: jnp.ndarray,
-                        feature_mask: jnp.ndarray, gp: GrowParams
-                        ) -> Tuple[TreeArrays, jnp.ndarray]:
-    """Grow one tree level-wise. Same interface as ops.grow.grow_tree; under
-    shard_map with gp.axis_name set, histograms are psum-reduced (data-parallel)."""
+def grow_tree_depthwise(bins: jnp.ndarray, g: jnp.ndarray, h: jnp.ndarray,
+                        c: jnp.ndarray, num_bins: jnp.ndarray,
+                        na_bin: jnp.ndarray, feature_mask: jnp.ndarray,
+                        gp: GrowParams) -> Tuple[TreeArrays, jnp.ndarray]:
+    """Grow one tree level-wise.
+
+    bins: [N, F] uint8; g/h/c: [N] f32 grad/hess/in-bag count channels (already
+    masked). Under shard_map with gp.axis_name set, histograms are psum-reduced
+    (data-parallel). Returns (TreeArrays, leaf_id [N] i32).
+    """
     n, f = bins.shape
     L, B = gp.num_leaves, gp.max_bin
     sp = gp.split
-    # unlimited depth => up to L-1 levels; the while_loop below exits as soon as
-    # a level selects no splits, so balanced trees still cost ~log2(L) passes
+    # unlimited depth => up to L-1 levels; the loop exits as soon as a level
+    # selects no splits, so balanced trees still cost ~log2(L) passes
     max_levels = gp.max_depth if gp.max_depth > 0 else max(1, L - 1)
-    SLOTS = (L + 1) // 2 + 1 if L > 2 else 2  # max splits in one level
+    MAX_SLOTS = (L + 1) // 2 + 1 if L > 2 else 2  # max splits in one level + 1
 
-    hist0 = _psum(H.hist_leaf(bins, ghc, B, gp.hist_impl), gp)
+    hist0 = _psum(H.hist_leaf(bins, g, h, c, B, gp.hist_impl), gp)
     g0 = hist0[0, :, 0].sum()
     h0 = hist0[0, :, 1].sum()
     c0 = hist0[0, :, 2].sum()
@@ -95,7 +101,7 @@ def grow_tree_depthwise(bins: jnp.ndarray, ghc: jnp.ndarray,
 
     leaves_iota = jnp.arange(L, dtype=jnp.int32)
 
-    def level(st: _DWState):
+    def level(st: _DWState, SLOTS: int):
         # ---- best split for every frontier leaf (vectorized over L) ----
         res = jax.vmap(lambda hh, g_, h_, c_, a_: best_split(
             hh, num_bins, na_bin, g_, h_, c_, feature_mask, sp, a_)
@@ -108,7 +114,7 @@ def grow_tree_depthwise(bins: jnp.ndarray, ghc: jnp.ndarray,
         key = jnp.where(cand, res.gain, -jnp.inf)
         order = jnp.argsort(-key)
         rank = jnp.zeros(L, jnp.int32).at[order].set(leaves_iota)
-        sel = cand & (rank < budget)
+        sel = cand & (rank < jnp.minimum(budget, SLOTS - 1))
         num_sel = sel.sum().astype(jnp.int32)
 
         # assignment order within the level: by leaf index
@@ -156,42 +162,20 @@ def grow_tree_depthwise(bins: jnp.ndarray, ghc: jnp.ndarray,
             num_leaves=tr.num_leaves + num_sel,
         )
 
-        # ---- apply all level splits to leaf_id in one pass ----
-        # All per-leaf lookups are packed into ONE [L, 6] table so each row costs a
-        # single gather (row-granularity gathers are ~5 ms/1M rows on TPU; doing
-        # five of them per level dominated the grower before this packing).
+        # ---- fused route + smaller-child histogram pass ----
         small_is_left = lc <= rc
-        # slot for rows that go right and the right child is the smaller one
-        slot_right = jnp.where(sel & ~small_is_left, idx_in_lvl, SLOTS)
-        # slot for rows that stay left and the left child is the smaller one
-        slot_left = jnp.where(sel & small_is_left, idx_in_lvl, SLOTS)
-        table = jnp.stack([
-            jnp.where(sel, feat, -1),                       # 0: split feature
-            thr,                                            # 1: threshold bin
-            dleft.astype(jnp.int32),                        # 2: default left
-            new_leaf,                                       # 3: right-child leaf id
-            slot_left,                                      # 4: hist slot if left
-            slot_right,                                     # 5: hist slot if right
-        ], axis=1)                                          # [L, 6]
-
-        rid = st.leaf_id
-        row = table[rid]                                    # [N, 6] single gather
-        fr = row[:, 0]
-        has_split = fr >= 0
-        # bins column + its na-bin via one-hot select (no per-row column gather)
-        fm = fr[:, None] == jnp.arange(f, dtype=jnp.int32)[None, :]   # [N, F]
-        col = jnp.sum(jnp.where(fm, bins.astype(jnp.int32), 0), axis=1)
-        na_sel = jnp.sum(jnp.where(fm, na_bin[None, :], 0), axis=1)
-        is_na = col == na_sel
-        go_right = jnp.where(is_na, row[:, 2] == 0, col > row[:, 1])
-        leaf_id2 = jnp.where(has_split & go_right, row[:, 3], rid)
-
-        # ---- smaller-child histograms: one pass, slot-indexed ----
-        slot_id = jnp.where(has_split,
-                            jnp.where(go_right, row[:, 5], row[:, 4]),
-                            jnp.int32(SLOTS))
-        hist_small = _psum(
-            H.hist_per_leaf(bins, ghc, slot_id, SLOTS, B, gp.hist_impl), gp)
+        tables = H.RouteTables(
+            feat=jnp.where(sel, feat, -1),
+            thr=thr,
+            dleft=dleft.astype(jnp.int32),
+            new_leaf=new_leaf,
+            # slot only for the smaller child; larger sibling = parent - smaller
+            slot_left=jnp.where(sel & small_is_left, idx_in_lvl, SLOTS),
+            slot_right=jnp.where(sel & ~small_is_left, idx_in_lvl, SLOTS),
+        )
+        hist_small, leaf_id2 = H.hist_routed(
+            bins, g, h, c, st.leaf_id, tables, na_bin, SLOTS, B, gp.hist_impl)
+        hist_small = _psum(hist_small, gp)
 
         leaf_of_slot = _scatter_set(jnp.full(SLOTS, _OOB, jnp.int32),
                                     idx_in_lvl, leaves_iota, sel)
@@ -228,15 +212,28 @@ def grow_tree_depthwise(bins: jnp.ndarray, ghc: jnp.ndarray,
             tree=tr,
         ), num_sel
 
-    def cond(carry):
-        st, lvl, last_sel = carry
-        return (lvl < max_levels) & (last_sel > 0)
+    # ---- bucketed level schedule ----
+    # Level k has at most min(2^k, MAX_SLOTS-1) splittable leaves, so the first
+    # ~log2(L) levels are Python-unrolled with small static slot counts — the
+    # histogram pass cost scales with the slot axis, and a fixed-width while_loop
+    # made every level pay for the deepest one (measured ~2x whole-tree cost at
+    # L=255). A while_loop tail covers unbalanced growth past the unroll.
+    n_unroll = min(max_levels, max(1, math.ceil(math.log2(max(L - 1, 2)))) + 1)
+    last_sel = jnp.int32(1)
+    for k in range(n_unroll):
+        slots_k = min(2 ** k, MAX_SLOTS - 1) + 1
+        state, last_sel = level(state, slots_k)
 
-    def body(carry):
-        st, lvl, _ = carry
-        st2, num_sel = level(st)
-        return st2, lvl + 1, num_sel
+    if max_levels > n_unroll:
+        def cond(carry):
+            st, lvl, last = carry
+            return (lvl < max_levels) & (last > 0)
 
-    state, _, _ = jax.lax.while_loop(
-        cond, body, (state, jnp.int32(0), jnp.int32(1)))
+        def body(carry):
+            st, lvl, _ = carry
+            st2, num_sel = level(st, MAX_SLOTS)
+            return st2, lvl + 1, num_sel
+
+        state, _, _ = jax.lax.while_loop(
+            cond, body, (state, jnp.int32(n_unroll), last_sel))
     return state.tree, state.leaf_id

@@ -4,17 +4,21 @@ TPU-native replacement for the reference's histogram machinery: the CPU hot loop
 ``DenseBin::ConstructHistogramInner`` (dense_bin.hpp:77-105), the row-wise multi-val
 path (multi_val_dense_bin.hpp:17) and the three OpenCL kernels
 (src/treelearner/ocl/histogram{16,64,256}.cl) all collapse into a small set of
-XLA/Pallas formulations over a dense ``[N, F]`` uint8 bin matrix:
+XLA formulations over a dense ``[N, F]`` uint8 bin matrix:
 
 - ``onehot``: tiled one-hot expansion contracted against the (grad, hess, count)
   channels on the MXU — no atomics needed (TPU has none), bandwidth-friendly tiles.
 - ``scatter``: XLA scatter-add (fast on CPU backends, used for tests / small data).
-- ``pallas``: hand-written Pallas kernel keeping the one-hot tile in VMEM (see
-  ops/pallas_hist.py).
 
-All return histograms with 3 channels: sum_grad, sum_hess, count (the reference packs
-(grad, hess) f64 pairs, bin.h:32-34; count is carried explicitly here because bagging
-is mask-based on TPU instead of index-subset based).
+Layout rules (learned the hard way — a [N, 3] f32 array tiles as T(8,128) with
+3 lanes padded to 128, a 42x HBM blowup at 10M rows):
+- gradient/hessian/count channels are SEPARATE 1-D [N] arrays, never [N, C];
+- all per-row intermediates live inside the row-tile scan body (fused, VMEM-sized);
+- the only full-size array ever materialized is the uint8 bin matrix itself.
+
+All histograms carry 3 channels: sum_grad, sum_hess, count (the reference packs
+(grad, hess) f64 pairs, bin.h:32-34; count is carried explicitly here because
+bagging is mask-based on TPU instead of index-subset based).
 
 The choice between implementations mirrors the reference's empirical col-wise vs
 row-wise auto-tune (``Dataset::TestMultiThreadingMethod``, dataset.cpp:640-715): see
@@ -23,7 +27,7 @@ row-wise auto-tune (``Dataset::TestMultiThreadingMethod``, dataset.cpp:640-715):
 from __future__ import annotations
 
 import functools
-from typing import Optional
+from typing import NamedTuple, Optional, Tuple
 
 import jax
 import jax.numpy as jnp
@@ -32,154 +36,300 @@ import numpy as np
 _DEF_TILE = 4096
 
 
-def _pad_rows(x: jnp.ndarray, mult: int):
+def _pad_1d(x: jnp.ndarray, mult: int, value=0):
     n = x.shape[0]
     pad = (-n) % mult
     if pad:
-        x = jnp.pad(x, [(0, pad)] + [(0, 0)] * (x.ndim - 1))
+        x = jnp.pad(x, [(0, pad)] + [(0, 0)] * (x.ndim - 1), constant_values=value)
     return x
 
 
-def _split_hi_lo(ghc: jnp.ndarray) -> jnp.ndarray:
-    """Split f32 channels into bf16 (hi, lo) pairs: ``[N, C] -> [N, 2C]`` bf16.
+def _split_hi_lo_tile(g: jnp.ndarray, h: jnp.ndarray, c: jnp.ndarray) -> jnp.ndarray:
+    """Stack f32 [T] channels into a [T, 6] bf16 (hi, lo) tile.
 
     The MXU runs bf16 natively; multiplying a bf16 value by an exact {0,1}
     one-hot and accumulating in f32 loses nothing, so hi+lo recovers ~f32
     accuracy (the reference accumulates f64 pairs, bin.h:32-34; GPU docs show
     f32 suffices, docs/GPU-Performance.rst:129-137 — bf16 alone does not)."""
+    ghc = jnp.stack([g, h, c], axis=1)
     hi = ghc.astype(jnp.bfloat16)
     lo = (ghc - hi.astype(jnp.float32)).astype(jnp.bfloat16)
-    return jnp.concatenate([hi, lo], axis=-1)
+    return jnp.concatenate([hi, lo], axis=-1)          # [T, 6]
 
 
-def hist_leaf_onehot(bins: jnp.ndarray, ghc: jnp.ndarray, num_bins: int,
-                     tile: int = _DEF_TILE, acc_dtype=jnp.float32) -> jnp.ndarray:
-    """Histogram of one row-subset: ``bins`` [N, F] uint8, ``ghc`` [N, 3] f32
+def _expand_onehot_2d(bins_t: jnp.ndarray, f: int, b: int) -> jnp.ndarray:
+    """One-hot bin expansion built entirely in 2D lane layout: [T, F] -> [T, F*B].
+
+    A naive ``(bins[:, :, None] == iota).reshape(T, F*B)`` makes XLA tile the
+    intermediate as a [.., F, B] array (lane dim B, padded to 128) and then pay a
+    relayout copy for the reshape. Instead the feature value is broadcast to its
+    B-lane group with a constant selector matmul (exact: bin ids <= 255 are
+    integers, exactly representable in bf16) and compared against a lane-indexed
+    bin id, so no minor-dim reshape ever happens."""
+    lane = jnp.arange(f * b, dtype=jnp.int32)
+    sel = (lane[None, :] // b == jnp.arange(f, dtype=jnp.int32)[:, None])
+    sel = sel.astype(jnp.bfloat16)                       # [F, F*B] constant
+    bin_of_lane = (lane % b).astype(jnp.float32)         # [F*B]
+    bv = jax.lax.dot_general(
+        bins_t.astype(jnp.bfloat16), sel,
+        dimension_numbers=(((1,), (0,)), ((), ())),
+        preferred_element_type=jnp.float32)              # [T, F*B]
+    return (bv == bin_of_lane[None, :]).astype(jnp.bfloat16)
+
+
+def _hi_lo_combine(hist: jnp.ndarray, f: int, b: int, l: int) -> jnp.ndarray:
+    """[F*B, L*6] accumulator -> [L, F, B, 3] f32 (hi+lo recombined)."""
+    hist = hist.reshape(f, b, l, 2, 3).sum(axis=3).transpose(2, 0, 1, 3)
+    return hist.astype(jnp.float32)
+
+
+class RouteTables(NamedTuple):
+    """Per-leaf split routing tables for one depthwise level, all [L] i32.
+
+    ``feat < 0`` means the leaf does not split this level. ``slot_left/right``
+    give the histogram slot the row lands in after routing (or the out-of-range
+    sentinel when that child is the larger sibling, reconstructed by
+    subtraction)."""
+    feat: jnp.ndarray
+    thr: jnp.ndarray
+    dleft: jnp.ndarray       # 1 if missing goes left
+    new_leaf: jnp.ndarray    # leaf id of the right child
+    slot_left: jnp.ndarray
+    slot_right: jnp.ndarray
+
+
+# ---------------------------------------------------------------------------
+# onehot (MXU) implementations
+# ---------------------------------------------------------------------------
+
+def hist_leaf_onehot(bins, g, h, c, num_bins: int, tile: int = _DEF_TILE,
+                     acc_dtype=jnp.float32) -> jnp.ndarray:
+    """Histogram of one row-subset: ``bins`` [N, F] uint8; g/h/c [N] f32
     (grad, hess, count — already masked: excluded rows have all-zero channels).
 
-    Returns [F, B, 3] float32. One-hot tiles are contracted on the MXU:
-    ``hist[f*B+b, c] = sum_t onehot[t, f*B+b] * ghc[t, c]``.
+    Returns [F, B, 3] float32.
     """
     n, f = bins.shape
     b = num_bins
-    bins = _pad_rows(bins, tile)
-    ghc = _pad_rows(ghc, tile)
+    bins = _pad_1d(bins, tile)
+    g, h, c = (_pad_1d(x, tile) for x in (g, h, c))
     n_tiles = bins.shape[0] // tile
     bins_t = bins.reshape(n_tiles, tile, f)
-    ghc_t = _split_hi_lo(ghc).reshape(n_tiles, tile, 6)
-    iota = jnp.arange(b, dtype=jnp.int32)
+    g_t = g.reshape(n_tiles, tile)
+    h_t = h.reshape(n_tiles, tile)
+    c_t = c.reshape(n_tiles, tile)
 
     def step(carry, xs):
-        bt, gt = xs
-        onehot = (bt.astype(jnp.int32)[:, :, None] == iota).astype(jnp.bfloat16)
-        onehot = onehot.reshape(tile, f * b)
+        bt, gt, ht, ct = xs
+        onehot = _expand_onehot_2d(bt, f, b)
+        ghc = _split_hi_lo_tile(gt, ht, ct)
         part = jax.lax.dot_general(
-            onehot, gt,
+            onehot, ghc,
             dimension_numbers=(((0,), (0,)), ((), ())),
             preferred_element_type=acc_dtype)
         return carry + part, None
 
     init = jnp.zeros((f * b, 6), dtype=acc_dtype)
-    hist, _ = jax.lax.scan(step, init, (bins_t, ghc_t))
+    hist, _ = jax.lax.scan(step, init, (bins_t, g_t, h_t, c_t))
     hist = hist[:, :3] + hist[:, 3:]
     return hist.reshape(f, b, 3).astype(jnp.float32)
 
 
-def hist_leaf_scatter(bins: jnp.ndarray, ghc: jnp.ndarray, num_bins: int) -> jnp.ndarray:
-    """Scatter-add histogram — XLA lowers to sorted-scatter; best on CPU backend."""
-    n, f = bins.shape
-    b = num_bins
-    idx = bins.astype(jnp.int32) + jnp.arange(f, dtype=jnp.int32)[None, :] * b  # [N,F]
-    hist = jnp.zeros((f * b, 3), dtype=jnp.float32)
-    vals = jnp.broadcast_to(ghc[:, None, :], (n, f, 3))
-    hist = hist.at[idx.reshape(-1)].add(vals.reshape(-1, 3))
-    return hist.reshape(f, b, 3)
+def _leaf_weight_2d(lt: jnp.ndarray, ghc6: jnp.ndarray, l: int) -> jnp.ndarray:
+    """Build w[t, s*6+c] = (lt[t]==s) * ghc6[t, c] without a [T, L, 6] reshape."""
+    lane = jnp.arange(l * 6, dtype=jnp.int32)
+    selc = (lane[None, :] % 6 == jnp.arange(6, dtype=jnp.int32)[:, None])
+    selc = selc.astype(jnp.bfloat16)                     # [6, L*6] constant
+    leaf_of_lane = lane // 6                             # [L*6]
+    gexp = jax.lax.dot_general(
+        ghc6, selc, dimension_numbers=(((1,), (0,)), ((), ())),
+        preferred_element_type=jnp.float32)              # [T, L*6]
+    return jnp.where(lt[:, None] == leaf_of_lane[None, :],
+                     gexp, 0.0).astype(jnp.bfloat16)     # exact
 
 
-def hist_per_leaf_onehot(bins: jnp.ndarray, ghc: jnp.ndarray, leaf_id: jnp.ndarray,
-                         num_leaves: int, num_bins: int, tile: int = _DEF_TILE,
-                         acc_dtype=jnp.float32) -> jnp.ndarray:
-    """Per-leaf histograms in one data pass (depthwise levels / distributed root).
-
-    Returns [L, F, B, 3]. Formulated as two chained one-hot contractions:
-    ``W[t, l*3+c] = onehot_leaf[t, l] * ghc[t, c]`` then
-    ``hist[f*B+b, l*3+c] = onehot_bin^T @ W`` — both MXU matmuls.
-    """
+def hist_per_leaf_onehot(bins, g, h, c, leaf_id, num_leaves: int, num_bins: int,
+                         tile: int = _DEF_TILE, acc_dtype=jnp.float32) -> jnp.ndarray:
+    """Per-leaf histograms in one data pass. Returns [L, F, B, 3] f32."""
     n, f = bins.shape
     b, l = num_bins, num_leaves
-    bins = _pad_rows(bins, tile)
-    ghc = _pad_rows(ghc, tile)
+    bins = _pad_1d(bins, tile)
+    g, h, c = (_pad_1d(x, tile) for x in (g, h, c))
     # padded rows get leaf_id = L (out of range -> zero one-hot row)
-    leaf_id = jnp.pad(leaf_id, (0, bins.shape[0] - n), constant_values=l)
+    leaf_id = _pad_1d(leaf_id, tile, value=l)
     n_tiles = bins.shape[0] // tile
     bins_t = bins.reshape(n_tiles, tile, f)
-    ghc_t = _split_hi_lo(ghc).reshape(n_tiles, tile, 6)
+    g_t = g.reshape(n_tiles, tile)
+    h_t = h.reshape(n_tiles, tile)
+    c_t = c.reshape(n_tiles, tile)
     lid_t = leaf_id.reshape(n_tiles, tile)
-    iota_b = jnp.arange(b, dtype=jnp.int32)
-    iota_l = jnp.arange(l, dtype=jnp.int32)
 
     def step(carry, xs):
-        bt, gt, lt = xs
-        onehot_b = (bt.astype(jnp.int32)[:, :, None] == iota_b).astype(jnp.bfloat16)
-        onehot_b = onehot_b.reshape(tile, f * b)
-        onehot_l = (lt[:, None] == iota_l).astype(jnp.bfloat16)          # [T, L]
-        w = onehot_l[:, :, None] * gt[:, None, :]                        # [T, L, 6]
+        bt, gt, ht, ct, lt = xs
+        onehot_b = _expand_onehot_2d(bt, f, b)                           # [T, F*B]
+        w = _leaf_weight_2d(lt, _split_hi_lo_tile(gt, ht, ct), l)        # [T, L*6]
         part = jax.lax.dot_general(
-            onehot_b, w.reshape(tile, l * 6),
+            onehot_b, w,
             dimension_numbers=(((0,), (0,)), ((), ())),
             preferred_element_type=acc_dtype)                            # [F*B, L*6]
         return carry + part, None
 
     init = jnp.zeros((f * b, l * 6), dtype=acc_dtype)
-    hist, _ = jax.lax.scan(step, init, (bins_t, ghc_t, lid_t))
-    hist = hist.reshape(f, b, l, 2, 3).sum(axis=3).transpose(2, 0, 1, 3)
-    return hist.astype(jnp.float32)
+    hist, _ = jax.lax.scan(step, init, (bins_t, g_t, h_t, c_t, lid_t))
+    return _hi_lo_combine(hist, f, b, l)
 
 
-def hist_per_leaf_scatter(bins: jnp.ndarray, ghc: jnp.ndarray, leaf_id: jnp.ndarray,
-                          num_leaves: int, num_bins: int) -> jnp.ndarray:
+def hist_routed_onehot(bins, g, h, c, leaf_id, tables: RouteTables, na_bin,
+                       num_slots: int, num_bins: int, tile: int = _DEF_TILE,
+                       acc_dtype=jnp.float32) -> Tuple[jnp.ndarray, jnp.ndarray]:
+    """Fused depthwise-level pass: route every row through its leaf's split (if
+    any) AND accumulate the smaller-child histograms, in one scan over the data.
+
+    This replaces the reference's DataPartition::Split + ConstructHistograms
+    pair (data_partition.hpp:113, dataset.cpp:1189) with a single fused pass.
+    Fusing matters beyond the extra data pass: routing as a standalone op
+    materializes [N, F]-shaped i32 temps whose TPU tilings waste 20-40x HBM
+    (OOM at 10M rows); inside the scan body every intermediate is tile-sized.
+
+    Returns (hist [S, F, B, 3] f32, new_leaf_id [N] i32).
+    """
+    n, f = bins.shape
+    b, s = num_bins, num_slots
+    bins_p = _pad_1d(bins, tile)
+    g, h, c = (_pad_1d(x, tile) for x in (g, h, c))
+    lid = _pad_1d(leaf_id, tile)   # padded rows route as leaf 0 but carry zero ghc
+    n_tiles = bins_p.shape[0] // tile
+
+    # per-leaf -> per-row lookups as full-size 1-D gathers (1-D layouts don't pad)
+    feat_r = jnp.take(tables.feat, lid).reshape(n_tiles, tile)
+    thr_r = jnp.take(tables.thr, lid).reshape(n_tiles, tile)
+    dleft_r = jnp.take(tables.dleft, lid).reshape(n_tiles, tile)
+    newl_r = jnp.take(tables.new_leaf, lid).reshape(n_tiles, tile)
+    sl_r = jnp.take(tables.slot_left, lid).reshape(n_tiles, tile)
+    sr_r = jnp.take(tables.slot_right, lid).reshape(n_tiles, tile)
+
+    bins_t = bins_p.reshape(n_tiles, tile, f)
+    g_t = g.reshape(n_tiles, tile)
+    h_t = h.reshape(n_tiles, tile)
+    c_t = c.reshape(n_tiles, tile)
+    lid_t = lid.reshape(n_tiles, tile)
+    iota_f = jnp.arange(f, dtype=jnp.int32)
+
+    def step(carry, xs):
+        bt, gt, ht, ct, lt, ft, tt, dt, nt, slt, srt = xs
+        # ---- route (vectorized NumericalDecision, tree.h:240) ----
+        fm = ft[:, None] == iota_f[None, :]                        # [T, F] in-fusion
+        colv = jnp.sum(jnp.where(fm, bt.astype(jnp.int32), 0), axis=1)
+        nav = jnp.sum(jnp.where(fm, na_bin[None, :], 0), axis=1)
+        has = ft >= 0
+        is_na = colv == nav
+        go_right = jnp.where(is_na, dt == 0, colv > tt)
+        lt2 = jnp.where(has & go_right, nt, lt)
+        slot = jnp.where(has, jnp.where(go_right, srt, slt), s)    # s = sentinel
+
+        # ---- accumulate smaller-child histograms by slot ----
+        onehot_b = _expand_onehot_2d(bt, f, b)
+        w = _leaf_weight_2d(slot, _split_hi_lo_tile(gt, ht, ct), s)
+        part = jax.lax.dot_general(
+            onehot_b, w,
+            dimension_numbers=(((0,), (0,)), ((), ())),
+            preferred_element_type=acc_dtype)
+        return carry + part, lt2
+
+    init = jnp.zeros((f * b, s * 6), dtype=acc_dtype)
+    hist, lid2 = jax.lax.scan(
+        step, init,
+        (bins_t, g_t, h_t, c_t, lid_t, feat_r, thr_r, dleft_r, newl_r, sl_r, sr_r))
+    return _hi_lo_combine(hist, f, b, s), lid2.reshape(-1)[:n]
+
+
+# ---------------------------------------------------------------------------
+# scatter implementations (CPU backend / tests)
+# ---------------------------------------------------------------------------
+
+def hist_leaf_scatter(bins, g, h, c, num_bins: int) -> jnp.ndarray:
+    """Scatter-add histogram — XLA lowers to sorted-scatter; best on CPU backend."""
+    n, f = bins.shape
+    b = num_bins
+    idx = bins.astype(jnp.int32) + jnp.arange(f, dtype=jnp.int32)[None, :] * b  # [N,F]
+    hist = jnp.zeros((f * b, 3), dtype=jnp.float32)
+    ghc = jnp.stack([g, h, c], axis=1)
+    vals = jnp.broadcast_to(ghc[:, None, :], (n, f, 3))
+    hist = hist.at[idx.reshape(-1)].add(vals.reshape(-1, 3))
+    return hist.reshape(f, b, 3)
+
+
+def hist_per_leaf_scatter(bins, g, h, c, leaf_id, num_leaves: int,
+                          num_bins: int) -> jnp.ndarray:
     n, f = bins.shape
     b, l = num_bins, num_leaves
     idx = (leaf_id[:, None] * f + jnp.arange(f, dtype=jnp.int32)[None, :]) * b \
         + bins.astype(jnp.int32)
     hist = jnp.zeros((l * f * b, 3), dtype=jnp.float32)
+    ghc = jnp.stack([g, h, c], axis=1)
     vals = jnp.broadcast_to(ghc[:, None, :], (n, f, 3))
-    hist = hist.at[idx.reshape(-1)].add(vals.reshape(-1, 3))
+    hist = hist.at[jnp.clip(idx.reshape(-1), 0, l * f * b - 1)].add(
+        vals.reshape(-1, 3))
     return hist.reshape(l, f, b, 3)
 
 
+def hist_routed_scatter(bins, g, h, c, leaf_id, tables: RouteTables, na_bin,
+                        num_slots: int, num_bins: int
+                        ) -> Tuple[jnp.ndarray, jnp.ndarray]:
+    n, f = bins.shape
+    feat = jnp.take(tables.feat, leaf_id)
+    has = feat >= 0
+    fsafe = jnp.maximum(feat, 0)
+    colv = jnp.take_along_axis(bins.astype(jnp.int32), fsafe[:, None], axis=1)[:, 0]
+    nav = jnp.take(na_bin, fsafe)
+    is_na = colv == nav
+    go_right = jnp.where(is_na, jnp.take(tables.dleft, leaf_id) == 0,
+                         colv > jnp.take(tables.thr, leaf_id))
+    lid2 = jnp.where(has & go_right, jnp.take(tables.new_leaf, leaf_id), leaf_id)
+    slot = jnp.where(has,
+                     jnp.where(go_right, jnp.take(tables.slot_right, leaf_id),
+                               jnp.take(tables.slot_left, leaf_id)),
+                     num_slots)
+    hist = hist_per_leaf_scatter(bins, g * (slot < num_slots), h * (slot < num_slots),
+                                 c * (slot < num_slots),
+                                 jnp.minimum(slot, num_slots - 1),
+                                 num_slots, num_bins)
+    return hist, lid2
+
+
+# ---------------------------------------------------------------------------
+# dispatch
+# ---------------------------------------------------------------------------
+
 def pick_impl(requested: str, backend: Optional[str] = None) -> str:
     """Empirical default (reference analog: dataset.cpp:640 runtime timing test):
-    scatter on CPU (XLA CPU scatter is fast, one-hot matmul is not), onehot/pallas
-    on TPU (no fast scatter on TPU; MXU contraction wins)."""
+    scatter on CPU (XLA CPU scatter is fast, one-hot matmul is not), onehot on
+    TPU (no fast scatter on TPU; MXU contraction wins)."""
     if requested and requested != "auto":
-        if requested == "pallas":
-            try:
-                from . import pallas_hist  # noqa: F401
-            except Exception:  # pragma: no cover
-                from ..utils import log
-                log.warning("pallas histogram kernel unavailable; using onehot")
-                return "onehot"
         return requested
     backend = backend or jax.default_backend()
     return "scatter" if backend == "cpu" else "onehot"
 
 
-def hist_leaf(bins, ghc, num_bins, impl="auto"):
+def hist_leaf(bins, g, h, c, num_bins, impl="auto"):
     impl = pick_impl(impl)
-    if impl == "onehot":
-        return hist_leaf_onehot(bins, ghc, num_bins)
-    if impl == "pallas":
-        from . import pallas_hist
-        return pallas_hist.hist_leaf_pallas(bins, ghc, num_bins)
-    return hist_leaf_scatter(bins, ghc, num_bins)
+    if impl == "scatter":
+        return hist_leaf_scatter(bins, g, h, c, num_bins)
+    return hist_leaf_onehot(bins, g, h, c, num_bins)
 
 
-def hist_per_leaf(bins, ghc, leaf_id, num_leaves, num_bins, impl="auto"):
+def hist_per_leaf(bins, g, h, c, leaf_id, num_leaves, num_bins, impl="auto"):
     impl = pick_impl(impl)
-    if impl == "onehot":
-        return hist_per_leaf_onehot(bins, ghc, leaf_id, num_leaves, num_bins)
-    if impl == "pallas":
-        from . import pallas_hist
-        return pallas_hist.hist_per_leaf_pallas(bins, ghc, leaf_id, num_leaves, num_bins)
-    return hist_per_leaf_scatter(bins, ghc, leaf_id, num_leaves, num_bins)
+    if impl == "scatter":
+        return hist_per_leaf_scatter(bins, g, h, c, leaf_id, num_leaves, num_bins)
+    return hist_per_leaf_onehot(bins, g, h, c, leaf_id, num_leaves, num_bins)
+
+
+def hist_routed(bins, g, h, c, leaf_id, tables, na_bin, num_slots, num_bins,
+                impl="auto"):
+    impl = pick_impl(impl)
+    if impl == "scatter":
+        return hist_routed_scatter(bins, g, h, c, leaf_id, tables, na_bin,
+                                   num_slots, num_bins)
+    return hist_routed_onehot(bins, g, h, c, leaf_id, tables, na_bin,
+                              num_slots, num_bins)

@@ -22,15 +22,16 @@ from ..ops.grow import GrowParams, TreeArrays, grow_tree
 from .mesh import DATA_AXIS
 
 
-def grow_tree_dp(bins, ghc, num_bins, na_bin, feature_mask,
+def grow_tree_dp(bins, g, h, c, num_bins, na_bin, feature_mask,
                  gp: GrowParams, mesh: Mesh,
                  grow_fn=grow_tree) -> Tuple[TreeArrays, jnp.ndarray]:
     """Grow one tree with rows sharded over ``mesh``'s data axis.
 
     ``grow_fn`` is either ops.grow.grow_tree (leaf-wise) or
     ops.grow_depthwise.grow_tree_depthwise (level-wise) — both psum their
-    histograms when gp.axis_name is set. bins/ghc must already be sharded along
-    rows; the returned TreeArrays are replicated, leaf_id stays row-sharded.
+    histograms when gp.axis_name is set. bins and the g/h/c channel arrays must
+    already be sharded along rows; the returned TreeArrays are replicated,
+    leaf_id stays row-sharded.
     """
     axis = mesh.axis_names[0]
     gp_dp = gp if gp.axis_name == axis else \
@@ -41,8 +42,8 @@ def grow_tree_dp(bins, ghc, num_bins, na_bin, feature_mask,
     fn = jax.shard_map(
         partial(grow_fn, gp=gp_dp),
         mesh=mesh,
-        in_specs=(P(axis, None), P(axis, None), P(), P(), P()),
+        in_specs=(P(axis, None), P(axis), P(axis), P(axis), P(), P(), P()),
         out_specs=(TreeArrays(*([P()] * len(TreeArrays._fields))), P(axis)),
         check_vma=False,
     )
-    return fn(bins, ghc, num_bins, na_bin, feature_mask)
+    return fn(bins, g, h, c, num_bins, na_bin, feature_mask)
