@@ -255,12 +255,13 @@ class Booster:
             # binning params can no longer be applied (reference raises
             # "Cannot change max_bin after constructed Dataset"); warn on the
             # worst silent footgun: a mismatched max_bin widens every histogram
-            mb_b = self.params.get("max_bin")
-            mb_d = train_set.params.get("max_bin")
-            if mb_b is not None and mb_d != mb_b:
+            # compare effective (alias-resolved, defaulted) values, not raw dicts
+            mb_b = params_to_config(self.params or {}).max_bin
+            mb_d = params_to_config(train_set.params or {}).max_bin
+            if mb_d != mb_b:
                 log.warning(
                     f"Dataset was constructed before max_bin={mb_b} could apply "
-                    f"(effective max_bin={params_to_config(train_set.params).max_bin}); "
+                    f"(effective max_bin={mb_d}); "
                     "pass params to Dataset() or let Booster construct it")
         train_set.params = {**self.params, **train_set.params} if train_set.params else dict(self.params)
         train_set.construct()

@@ -144,7 +144,7 @@ _PARAMS: Dict[str, Tuple[Any, Tuple[str, ...]]] = {
     "gpu_device_id": (-1, ()),
     "gpu_use_dp": (False, ()),
     # ---- TPU-specific (new in this framework) ----
-    "histogram_impl": ("auto", ()),        # auto | onehot | scatter | pallas
+    "histogram_impl": ("auto", ()),        # auto | onehot | scatter
     # depthwise is the TPU default: O(depth) histogram passes per tree instead of
     # O(num_leaves) (the reference's leaf-wise semantics are available via
     # grow_policy=lossguide; tree quality is near-identical because depthwise

@@ -51,4 +51,4 @@ class GOSS(GBDT):
     def _make_ghc(self, g, h):
         m = self._bag_mask
         # count channel counts in-bag rows (weight 0/1), amplified rows count once
-        return jnp.stack([g * m, h * m, (m > 0).astype(g.dtype)], axis=1)
+        return g * m, h * m, (m > 0).astype(g.dtype)

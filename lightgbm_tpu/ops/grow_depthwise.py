@@ -222,7 +222,13 @@ def grow_tree_depthwise(bins: jnp.ndarray, g: jnp.ndarray, h: jnp.ndarray,
     last_sel = jnp.int32(1)
     for k in range(n_unroll):
         slots_k = min(2 ** k, MAX_SLOTS - 1) + 1
-        state, last_sel = level(state, slots_k)
+        # early exit: once a level selects no splits the tree is finished — skip
+        # the remaining unrolled full-data passes (they would be expensive no-ops)
+        state, last_sel = jax.lax.cond(
+            last_sel > 0,
+            lambda st: level(st, slots_k),
+            lambda st: (st, jnp.int32(0)),
+            state)
 
     if max_levels > n_unroll:
         def cond(carry):

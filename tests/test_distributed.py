@@ -32,17 +32,20 @@ def test_dp_tree_matches_serial(mesh):
     bins = jnp.asarray(rng.randint(0, b, size=(n, f)).astype(np.uint8))
     g = rng.randn(n).astype(np.float32)
     h = np.ones(n, dtype=np.float32)
-    ghc = jnp.asarray(np.stack([g, h, h], axis=1))
+    gj = jnp.asarray(g)
+    hj = jnp.asarray(h)
+    cj = jnp.asarray(h)
     num_bins = jnp.full(f, b, dtype=jnp.int32)
     na_bin = jnp.full(f, 256, dtype=jnp.int32)
     fmask = jnp.ones(f, dtype=bool)
     gp = GrowParams(num_leaves=8, max_bin=b,
                     split=SplitParams(min_data_in_leaf=5), hist_impl="scatter")
 
-    tree_s, leaf_s = grow_tree(bins, ghc, num_bins, na_bin, fmask, gp)
+    tree_s, leaf_s = grow_tree(bins, gj, hj, cj, num_bins, na_bin, fmask, gp)
     bins_dp = shard_rows(bins, mesh)
-    ghc_dp = shard_rows(ghc, mesh)
-    tree_d, leaf_d = grow_tree_dp(bins_dp, ghc_dp, num_bins, na_bin, fmask, gp, mesh)
+    g_dp, h_dp, c_dp = (shard_rows(x, mesh) for x in (gj, hj, cj))
+    tree_d, leaf_d = grow_tree_dp(bins_dp, g_dp, h_dp, c_dp, num_bins, na_bin,
+                                  fmask, gp, mesh)
 
     assert int(tree_s.num_leaves) == int(tree_d.num_leaves)
     np.testing.assert_array_equal(np.asarray(tree_s.split_feature),

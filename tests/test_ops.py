@@ -35,7 +35,8 @@ def test_hist_leaf_matches_numpy(impl):
     bins, g, h = _rand_problem()
     ghc = np.stack([g, h, np.ones_like(g)], axis=1)
     ref = _np_hist(bins, ghc, 16)
-    out = np.asarray(H.hist_leaf(jnp.asarray(bins), jnp.asarray(ghc), 16, impl))
+    out = np.asarray(H.hist_leaf(jnp.asarray(bins), jnp.asarray(g), jnp.asarray(h),
+                                 jnp.ones(len(g), jnp.float32), 16, impl))
     np.testing.assert_allclose(out, ref, rtol=1e-4, atol=1e-4)
 
 
@@ -43,7 +44,8 @@ def test_hist_scatter_exact():
     bins, g, h = _rand_problem()
     ghc = np.stack([g, h, np.ones_like(g)], axis=1)
     ref = _np_hist(bins, ghc, 16)
-    out = np.asarray(H.hist_leaf(jnp.asarray(bins), jnp.asarray(ghc), 16, "scatter"))
+    out = np.asarray(H.hist_leaf(jnp.asarray(bins), jnp.asarray(g), jnp.asarray(h),
+                                 jnp.ones(len(g), jnp.float32), 16, "scatter"))
     np.testing.assert_allclose(out, ref, rtol=1e-5, atol=1e-4)
 
 
@@ -57,7 +59,8 @@ def test_hist_per_leaf(impl):
     for i in range(300):
         for j in range(4):
             ref[leaf[i], j, bins[i, j]] += ghc[i]
-    out = np.asarray(H.hist_per_leaf(jnp.asarray(bins), jnp.asarray(ghc),
+    out = np.asarray(H.hist_per_leaf(jnp.asarray(bins), jnp.asarray(g),
+                                     jnp.asarray(h), jnp.ones(300, jnp.float32),
                                      jnp.asarray(leaf), 4, 16, impl))
     np.testing.assert_allclose(out, ref, rtol=1e-4, atol=1e-4)
 
@@ -132,8 +135,8 @@ def test_grow_tree_depth1_optimal():
     na_bin = jnp.asarray(np.array([256, 256, 256], dtype=np.int32))
     p = SplitParams(min_data_in_leaf=5)
     gp = GrowParams(num_leaves=2, max_bin=8, split=p, hist_impl="scatter")
-    tree, leaf_id = grow_tree(jnp.asarray(bins), ghc, num_bins, na_bin,
-                              jnp.ones(3, dtype=bool), gp)
+    tree, leaf_id = grow_tree(jnp.asarray(bins), ghc[:, 0], ghc[:, 1], ghc[:, 2],
+                              num_bins, na_bin, jnp.ones(3, dtype=bool), gp)
     hist = _np_hist(bins, np.asarray(ghc), 8)
     ref_gain, ref_f, ref_t, _ = _np_best_split(
         hist, np.array([8, 8, 8]), np.array([-1, -1, -1]), p)
@@ -158,8 +161,8 @@ def test_grow_tree_respects_num_leaves_and_count():
     na_bin = jnp.asarray(np.full(4, 256, dtype=np.int32))
     gp = GrowParams(num_leaves=8, max_bin=16,
                     split=SplitParams(min_data_in_leaf=10), hist_impl="scatter")
-    tree, leaf_id = grow_tree(jnp.asarray(bins), ghc, num_bins, na_bin,
-                              jnp.ones(4, dtype=bool), gp)
+    tree, leaf_id = grow_tree(jnp.asarray(bins), ghc[:, 0], ghc[:, 1], ghc[:, 2],
+                              num_bins, na_bin, jnp.ones(4, dtype=bool), gp)
     nl = int(tree.num_leaves)
     assert 2 <= nl <= 8
     lid = np.asarray(leaf_id)
@@ -178,6 +181,6 @@ def test_grow_tree_max_depth():
     na_bin = jnp.asarray(np.full(4, 256, dtype=np.int32))
     gp = GrowParams(num_leaves=31, max_depth=2, max_bin=16,
                     split=SplitParams(min_data_in_leaf=1), hist_impl="scatter")
-    tree, _ = grow_tree(jnp.asarray(bins), ghc, num_bins, na_bin,
-                        jnp.ones(4, dtype=bool), gp)
+    tree, _ = grow_tree(jnp.asarray(bins), ghc[:, 0], ghc[:, 1], ghc[:, 2],
+                        num_bins, na_bin, jnp.ones(4, dtype=bool), gp)
     assert int(tree.num_leaves) <= 4  # depth 2 -> at most 4 leaves
