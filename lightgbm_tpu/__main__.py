@@ -1,0 +1,8 @@
+"""``python -m lightgbm_tpu`` — the reference's ``lightgbm`` CLI binary
+(src/main.cpp:9-31)."""
+import sys
+
+from .app import main
+
+if __name__ == "__main__":
+    sys.exit(main(sys.argv[1:]))

@@ -1,0 +1,140 @@
+"""Application driver — the CLI entry the reference ships as the ``lightgbm``
+binary (src/application/application.cpp:84-252: ``task=train`` ->
+Application::Train, ``task=predict`` -> Predict, ``task=convert_model`` ->
+ConvertModel; config-file + key=value argument parsing in main.cpp:13).
+
+Usage (same conventions as the reference binary):
+
+    python -m lightgbm_tpu config=train.conf [key=value ...]
+    python -m lightgbm_tpu task=train data=binary.train objective=binary ...
+
+Key=value pairs on the command line override the config file (main.cpp:26 ->
+config.cpp Str2Map precedence).
+"""
+from __future__ import annotations
+
+import os
+import sys
+import time
+from typing import Dict, List
+
+import numpy as np
+
+from .basic import Booster, Dataset
+from .config import Config, canonical_name
+from .engine import train as engine_train
+from .io.parser import load_file
+from .utils import log
+
+
+def parse_args(argv: List[str]) -> Dict[str, str]:
+    """key=value args + optional ``config=file`` whose lines are key=value
+    (``#`` comments). CLI pairs override file pairs (main.cpp:21-30)."""
+    cli = Config.str2map(argv)
+    conf_path = None
+    for k in list(cli):
+        if canonical_name(k) == "config":
+            conf_path = cli.pop(k)
+    merged: Dict[str, str] = {}
+    if conf_path:
+        if not os.path.exists(conf_path):
+            log.fatal(f"Config file {conf_path} does not exist")
+        with open(conf_path) as fh:
+            for line in fh:
+                line = line.split("#", 1)[0].strip()
+                if not line or "=" not in line:
+                    continue
+                k, v = line.split("=", 1)
+                merged[k.strip()] = v.strip()
+    merged.update(cli)
+    return merged
+
+
+def _load_dataset(path: str, conf: Config, params: Dict, reference=None,
+                  num_features_hint: int = 0) -> Dataset:
+    pf = load_file(path, header=conf.header, label_column=conf.label_column,
+                   weight_column=conf.weight_column,
+                   group_column=conf.group_column,
+                   ignore_column=conf.ignore_column,
+                   num_features_hint=num_features_hint)
+    ds = Dataset(pf.X, label=pf.label, weight=pf.weight, group=pf.group,
+                 init_score=pf.init_score, reference=reference, params=params,
+                 feature_name=pf.feature_names or "auto")
+    return ds
+
+
+def run_train(conf: Config, params: Dict) -> None:
+    if not conf.data:
+        log.fatal("No training data: set data=<file>")
+    t0 = time.time()
+    train_set = _load_dataset(conf.data, conf, params)
+    valid_sets, valid_names = [], []
+    for vpath in conf.valid:
+        vs = _load_dataset(vpath, conf, params, reference=train_set)
+        valid_sets.append(vs)
+        valid_names.append(os.path.basename(vpath))
+    log.info(f"Finished loading data in {time.time() - t0:.6f} seconds")
+
+    init_model = conf.input_model if conf.input_model else None
+    booster = engine_train(
+        params, train_set, num_boost_round=conf.num_iterations,
+        valid_sets=valid_sets, valid_names=valid_names,
+        init_model=init_model,
+        verbose_eval=conf.metric_freq if conf.metric_freq > 0 else False)
+    booster.save_model(conf.output_model)
+    log.info(f"Finished training; model saved to {conf.output_model}")
+
+
+def run_predict(conf: Config, params: Dict) -> None:
+    if not conf.data:
+        log.fatal("No data to predict: set data=<file>")
+    if not conf.input_model:
+        log.fatal("No model file: set input_model=<file>")
+    booster = Booster(model_file=conf.input_model)
+    nf = booster.num_feature()
+    pf = load_file(conf.data, header=conf.header,
+                   label_column=conf.label_column,
+                   weight_column=conf.weight_column,
+                   group_column=conf.group_column,
+                   ignore_column=conf.ignore_column, num_features_hint=nf)
+    X = pf.X
+    if X.shape[1] < nf:  # file sparser than train data (LibSVM tail zeros)
+        X = np.pad(X, ((0, 0), (0, nf - X.shape[1])))
+    pred = booster.predict(
+        X, raw_score=conf.predict_raw_score,
+        pred_leaf=conf.predict_leaf_index, pred_contrib=conf.predict_contrib)
+    out = np.asarray(pred)
+    if out.ndim == 1:
+        out = out[:, None]
+    fmt = "%d" if conf.predict_leaf_index else "%.18g"
+    np.savetxt(conf.output_result, out, fmt=fmt, delimiter="\t")
+    log.info(f"Finished prediction; results saved to {conf.output_result}")
+
+
+def run_convert_model(conf: Config, params: Dict) -> None:
+    if not conf.input_model:
+        log.fatal("No model file: set input_model=<file>")
+    from .io.model_text import model_to_cpp
+    booster = Booster(model_file=conf.input_model)
+    out = conf.convert_model if conf.convert_model else "gbdt_prediction.cpp"
+    with open(out, "w") as fh:
+        fh.write(model_to_cpp(booster, booster._ensure_host_trees()))
+    log.info(f"Finished converting model; C++ code saved to {out}")
+
+
+def main(argv: List[str]) -> int:
+    if not argv or argv[0] in ("-h", "--help"):
+        print(__doc__)
+        return 0
+    params = parse_args(argv)
+    conf = Config(params)
+    task = conf.task
+    if task == "train" or task == "refit":
+        run_train(conf, params)
+    elif task == "predict" or task == "prediction" or task == "test":
+        run_predict(conf, params)
+    elif task == "convert_model":
+        run_convert_model(conf, params)
+    else:
+        log.fatal(f"Unknown task: {task}")
+    return 0

@@ -70,7 +70,7 @@ def train(params: Dict[str, Any], train_set: Dataset,
                                            verbose=bool(verbose_eval)))
     if verbose_eval is True:
         callbacks.append(cb.print_evaluation())
-    elif isinstance(verbose_eval, int) and verbose_eval > 1:
+    elif isinstance(verbose_eval, int) and verbose_eval >= 1:
         callbacks.append(cb.print_evaluation(verbose_eval))
     if evals_result is not None:
         callbacks.append(cb.record_evaluation(evals_result))
