@@ -110,6 +110,11 @@ class Dataset:
     def construct(self) -> "Dataset":
         if self._constructed:
             return self
+        from .utils.timer import TIMER
+        with TIMER.scope("dataset_construct"):
+            return self._construct_inner()
+
+    def _construct_inner(self) -> "Dataset":
         conf = params_to_config(self.params)
         if self.reference is not None:
             ref = self.reference.construct()

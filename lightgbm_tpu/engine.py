@@ -7,6 +7,7 @@ alignment to the train set, early stopping, continued training from an init mode
 from __future__ import annotations
 
 import copy
+import time
 from typing import Any, Callable, Dict, List, Optional, Union
 
 import numpy as np
@@ -15,6 +16,7 @@ from . import callback as cb
 from .basic import Booster, Dataset
 from .config import Config, params_to_config
 from .utils import log
+from .utils.timer import TIMER
 
 
 def train(params: Dict[str, Any], train_set: Dataset,
@@ -83,6 +85,7 @@ def train(params: Dict[str, Any], train_set: Dataset,
     begin_iteration = booster.current_iteration
     end_iteration = begin_iteration + num_boost_round
     finished = False
+    t_start = time.perf_counter()
     try:
         for i in range(begin_iteration, end_iteration):
             for c in callbacks_before:
@@ -90,20 +93,33 @@ def train(params: Dict[str, Any], train_set: Dataset,
                                  begin_iteration=begin_iteration,
                                  end_iteration=end_iteration,
                                  evaluation_result_list=None))
-            finished = booster.update(fobj=fobj)
+            with TIMER.scope("boosting"):
+                finished = booster.update(fobj=fobj)
             evaluation_result_list = []
             if booster._gbdt.valid_sets or eval_training:
-                if eval_training:
-                    evaluation_result_list.extend(booster.eval_train())
-                evaluation_result_list.extend(booster.eval_valid())
-                if feval is not None:
-                    evaluation_result_list.extend(
-                        _run_feval(feval, booster, train_set, eval_training))
+                with TIMER.scope("eval"):
+                    if eval_training:
+                        evaluation_result_list.extend(booster.eval_train())
+                    evaluation_result_list.extend(booster.eval_valid())
+                    if feval is not None:
+                        evaluation_result_list.extend(
+                            _run_feval(feval, booster, train_set, eval_training))
             for c in callbacks_after:
                 c(cb.CallbackEnv(model=booster, params=params, iteration=i,
                                  begin_iteration=begin_iteration,
                                  end_iteration=end_iteration,
                                  evaluation_result_list=evaluation_result_list))
+            # per-iteration wall clock (reference: gbdt.cpp:289 "%f seconds
+            # elapsed, finished iteration %d" at every metric output interval)
+            if conf.verbosity >= 1 and conf.metric_freq > 0 \
+                    and (i + 1) % conf.metric_freq == 0:
+                log.debug("%.6f seconds elapsed, finished iteration %d",
+                          time.perf_counter() - t_start, i + 1)
+            # periodic snapshots (reference: gbdt.cpp:291-295 snapshot_freq)
+            if conf.snapshot_freq > 0 and (i + 1) % conf.snapshot_freq == 0:
+                snap = f"snapshot_iter_{i + 1}.txt"
+                booster.save_model(snap)
+                log.info("Saved snapshot to %s", snap)
             if finished:
                 log.warning("Stopped training because there are no more leaves "
                             "that meet the split requirements")
@@ -112,7 +128,10 @@ def train(params: Dict[str, Any], train_set: Dataset,
         booster.best_iteration = e.best_iteration + 1
         for item in (e.best_score or []):
             booster.best_score.setdefault(item[0], {})[item[1]] = item[2]
-    booster._ensure_host_trees()
+    with TIMER.scope("finalize"):
+        booster._ensure_host_trees()
+    if conf.verbosity >= 2:
+        log.debug(TIMER.summary_string())
     return booster
 
 

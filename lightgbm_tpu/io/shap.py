@@ -34,7 +34,11 @@ def _tree_shap_single(tree: Tree, x: np.ndarray, phi: np.ndarray) -> None:
 
     # PATH is a list of (feature, zero_fraction, one_fraction, pweight)
     def extend(path, pzf, pof, pfi):
-        path = path + [[pfi, pzf, pof, 1.0 if len(path) == 0 else 0.0]]
+        # rows must be DEEP-copied: the hot-branch recursion would otherwise
+        # mutate pweights aliased into the caller's path before the cold branch
+        # reads them (matches shap's extendPath on a copied buffer)
+        path = [row[:] for row in path] + [[pfi, pzf, pof,
+                                            1.0 if len(path) == 0 else 0.0]]
         l = len(path) - 1
         for i in range(l - 1, -1, -1):
             path[i + 1][3] += pof * path[i][3] * (i + 1) / (l + 1)
@@ -42,6 +46,10 @@ def _tree_shap_single(tree: Tree, x: np.ndarray, phi: np.ndarray) -> None:
         return path
 
     def unwind(path, i):
+        # remove element i: pweights are recomputed IN PLACE for positions
+        # 0..l-1 while (feature, zero_fraction, one_fraction) shift down from
+        # i+1 — shifting pweights too (e.g. `del path[i]`) corrupts the
+        # distribution (matches shap's unwindPath, tree_shap.h)
         l = len(path) - 1
         one_fraction = path[i][2]
         zero_fraction = path[i][1]
@@ -54,9 +62,11 @@ def _tree_shap_single(tree: Tree, x: np.ndarray, phi: np.ndarray) -> None:
                 n = t - path[j][3] * zero_fraction * (l - j) / (l + 1)
             else:
                 path[j][3] = path[j][3] * (l + 1) / (zero_fraction * (l - j))
-        del path[i]
-        for j in range(i, len(path)):
-            path[j][0] = path[j][0]
+        for j in range(i, l):
+            path[j][0] = path[j + 1][0]
+            path[j][1] = path[j + 1][1]
+            path[j][2] = path[j + 1][2]
+        path.pop()
         return path
 
     def unwound_sum(path, i):
@@ -110,8 +120,14 @@ def _tree_shap_single(tree: Tree, x: np.ndarray, phi: np.ndarray) -> None:
         recurse(hot, path, hzf * izf, iof, feat)
         recurse(cold, path, czf * izf, 0.0, feat)
 
-    # base value: expectation of the tree output
-    phi[-1] += tree.internal_value[0]
+    # base value: coverage-weighted expectation of the tree output (reference:
+    # Tree::ExpectedValue = sum(leaf_count*leaf_value)/count, tree.h — NOT the
+    # root's regularized output, which diverges under lambda_l2/leaf renewal)
+    nl = tree.num_leaves
+    cnt = leaf_counts[:nl]
+    tot = cnt.sum()
+    phi[-1] += (float(np.dot(cnt, tree.leaf_value[:nl])) / tot
+                if tot > 0 else tree.leaf_value[0])
     recurse(0, [], 1.0, 1.0, -1)
 
 

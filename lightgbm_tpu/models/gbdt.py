@@ -279,22 +279,32 @@ class GBDT:
                 self._update_valid_scores(tree_dev, cls,
                                           bias=self.init_scores[cls]
                                           if bias_active else 0.0)
-            # finished-check without stalling the pipeline: read LAST iteration's
-            # leaf counts (already computed) while this one executes; trailing
-            # single-leaf trees are dropped to match the reference's
-            # stop-without-adding behavior (gbdt.cpp:430)
-            prev = getattr(self, "_pending_leafcounts", None)
-            self._pending_leafcounts = [t.num_leaves for t, _ in trees]
-            for x in self._pending_leafcounts:
+            # finished-check without stalling the pipeline: reading num_leaves
+            # of the *previous* iteration still blocks on that iteration's
+            # completion — through a tunneled TPU runtime that serializes every
+            # update into dispatch-latency + device-time (~100 ms each,
+            # measured). Instead queue the async copies and only force-read
+            # counts ≥8 iterations old (long since finished — zero blocking);
+            # stop detection lags ≤8 iters and trailing single-leaf trees are
+            # popped, matching the reference's stop-without-adding behavior
+            # (gbdt.cpp:430)
+            q = getattr(self, "_pending_leafcounts_q", None)
+            if q is None:
+                q = self._pending_leafcounts_q = []
+            cnts = [t.num_leaves for t, _ in trees]
+            for x in cnts:
                 try:
                     x.copy_to_host_async()
                 except Exception:
                     pass
-            if prev is not None and all(int(x) <= 1 for x in prev):
-                while self.models_dev and \
-                        int(self.models_dev[-1].num_leaves) <= 1:
-                    self.models_dev.pop()
-                return True
+            q.append(cnts)
+            if len(q) > 8:
+                old = q.pop(0)
+                if all(int(x) <= 1 for x in old):
+                    while self.models_dev and \
+                            int(self.models_dev[-1].num_leaves) <= 1:
+                        self.models_dev.pop()
+                    return True
             return False
         return self._grow_and_update_slow(grad, hess)
 

@@ -251,11 +251,11 @@ class Tree:
         """C++ codegen of this tree (reference: Tree::ToIfElse, tree.h:200)."""
         def rec(ptr: int, indent: str) -> str:
             if ptr < 0:
-                return f"{indent}return {self.leaf_value[~ptr]!r};\n"
-            f_ = self.split_feature[ptr]
-            thr = self.threshold_real[ptr]
+                return f"{indent}return {float(self.leaf_value[~ptr]):.17g};\n"
+            f_ = int(self.split_feature[ptr])
+            thr = float(self.threshold_real[ptr])
             dl = "true" if self.default_left[ptr] else "false"
-            s = f"{indent}if (IsLeft(arr[{f_}], {thr!r}, {dl})) {{\n"
+            s = f"{indent}if (IsLeft(arr[{f_}], {thr:.17g}, {dl})) {{\n"
             s += rec(int(self.left_child[ptr]), indent + "  ")
             s += f"{indent}}} else {{\n"
             s += rec(int(self.right_child[ptr]), indent + "  ")

@@ -1,0 +1,92 @@
+"""Named-scope timing registry.
+
+Analog of the reference's ``Timer``/``FunctionTimer`` profiling registry
+(src/utils/common.h:1032-1093, enabled with USE_TIMER): named accumulating
+wall-clock scopes, printed as a sorted table. TPU addition: scopes also emit
+``jax.profiler.TraceAnnotation`` ranges so the same names line up in XLA
+profiler traces, and a scope can optionally block on device results so
+asynchronous dispatch doesn't attribute device time to the wrong scope.
+
+Usage::
+
+    from lightgbm_tpu.utils.timer import TIMER, timed
+
+    with TIMER.scope("hist"):
+        ...
+    @timed("construct_bins")
+    def f(...): ...
+
+    TIMER.summary_string()  # -> table; printed at end of training at verbosity>=1
+"""
+from __future__ import annotations
+
+import contextlib
+import time
+from typing import Dict, Optional
+
+import jax
+
+
+class TimerRegistry:
+    def __init__(self) -> None:
+        self._acc: Dict[str, float] = {}
+        self._cnt: Dict[str, int] = {}
+        self.enabled = True
+
+    def reset(self) -> None:
+        self._acc.clear()
+        self._cnt.clear()
+
+    @contextlib.contextmanager
+    def scope(self, name: str, block_on=None):
+        """Accumulate wall time under ``name``. If ``block_on`` is a callable,
+        its result is block_until_ready'd before the clock stops (so the scope
+        covers device execution, not just async dispatch)."""
+        if not self.enabled:
+            yield
+            return
+        t0 = time.perf_counter()
+        with jax.profiler.TraceAnnotation(name):
+            yield
+            if block_on is not None:
+                jax.block_until_ready(block_on() if callable(block_on) else block_on)
+        dt = time.perf_counter() - t0
+        self._acc[name] = self._acc.get(name, 0.0) + dt
+        self._cnt[name] = self._cnt.get(name, 0) + 1
+
+    def add(self, name: str, seconds: float) -> None:
+        self._acc[name] = self._acc.get(name, 0.0) + seconds
+        self._cnt[name] = self._cnt.get(name, 0) + 1
+
+    def get(self, name: str) -> float:
+        return self._acc.get(name, 0.0)
+
+    def summary_string(self) -> str:
+        """Sorted table (reference prints the same at program exit,
+        common.h:1056 Timer::~Timer)."""
+        if not self._acc:
+            return "No timing scopes recorded"
+        lines = ["LightGBM-TPU timing summary:"]
+        width = max(len(k) for k in self._acc)
+        for name, sec in sorted(self._acc.items(), key=lambda kv: -kv[1]):
+            lines.append(f"  {name:<{width}s} {sec:10.3f} s  "
+                         f"(x{self._cnt[name]})")
+        return "\n".join(lines)
+
+
+TIMER = TimerRegistry()
+
+
+def timed(name: str, block: bool = False):
+    """Decorator form (reference: FunctionTimer, common.h:1076)."""
+    def wrap(fn):
+        def inner(*args, **kwargs):
+            with TIMER.scope(name):
+                out = fn(*args, **kwargs)
+                if block:
+                    jax.block_until_ready(out)
+            return out
+        inner.__name__ = getattr(fn, "__name__", name)
+        inner.__doc__ = fn.__doc__
+        return inner
+    return wrap

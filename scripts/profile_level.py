@@ -1,0 +1,140 @@
+"""Isolate one depthwise level() call (with bookkeeping) vs its hist_routed core,
+and test whether the [L,F,B,3] minor-dim-3 state layout is the bottleneck."""
+import sys
+sys.path.insert(0, "/root/repo")
+import time
+from functools import partial
+import numpy as np
+import jax
+import jax.numpy as jnp
+
+jax.config.update("jax_compilation_cache_dir", "/tmp/jax_cache_lgbm_tpu")
+
+from lightgbm_tpu.ops import histogram as H
+from lightgbm_tpu.ops.grow import GrowParams, _empty_tree
+from lightgbm_tpu.ops.grow_depthwise import _DWState, grow_tree_depthwise
+from lightgbm_tpu.ops.split import SplitParams
+
+N, F, B, L = 1_000_000, 28, 64, 255
+rng = np.random.RandomState(0)
+bins = jnp.asarray(rng.randint(0, 63, size=(N, F)).astype(np.uint8))
+g = jnp.asarray(rng.randn(N).astype(np.float32))
+h = jnp.asarray(rng.rand(N).astype(np.float32))
+c = jnp.ones(N, jnp.float32)
+num_bins = jnp.full(F, 63, jnp.int32)
+na_bin = jnp.full(F, 256, jnp.int32)
+fmask = jnp.ones(F, bool)
+sp = SplitParams(min_data_in_leaf=20)
+gp = GrowParams(num_leaves=L, max_bin=B, split=sp, hist_impl="onehot")
+
+
+def t_loop(name, op, K=6, reps=3):
+    def loop(k):
+        def body(i, acc):
+            return acc + op(1.0 + i.astype(jnp.float32) * 1e-9)
+        return jax.lax.fori_loop(0, k, body, jnp.zeros((), jnp.float32))
+    f1 = jax.jit(partial(loop, 1))
+    fK = jax.jit(partial(loop, K))
+    jax.block_until_ready(f1()); jax.block_until_ready(fK())
+    def t(f):
+        best = 1e9
+        for _ in range(reps):
+            t0 = time.time(); jax.block_until_ready(f()); best = min(best, time.time() - t0)
+        return best
+    per = (t(fK) - t(f1)) / (K - 1)
+    print(f"{name:50s} {per*1000:9.2f} ms")
+    return per
+
+
+# full level() including bookkeeping, SLOTS=128 — replicate by calling the inner
+# machinery via grow with max_depth trick is hard; instead re-create level here.
+from lightgbm_tpu.ops.grow_depthwise import _scatter_set, _OOB
+from lightgbm_tpu.ops.split import best_split, leaf_output, NEG_INF
+
+leaf_id0 = jnp.asarray(rng.randint(0, 128, size=N).astype(np.int32))
+hist_state = jnp.asarray(rng.rand(L, F, B, 3).astype(np.float32))
+leaf_g = jnp.asarray(rng.randn(L).astype(np.float32))
+leaf_h = jnp.abs(jnp.asarray(rng.randn(L).astype(np.float32))) + 1
+leaf_c = jnp.full(L, 4000.0)
+active = jnp.ones(L, bool)
+leaves_iota = jnp.arange(L, dtype=jnp.int32)
+SLOTS = 128
+
+
+def one_level(s):
+    st_hist = hist_state * s
+    res = jax.vmap(lambda hh, g_, h_, c_, a_: best_split(
+        hh, num_bins, na_bin, g_, h_, c_, fmask, sp, a_)
+    )(st_hist, leaf_g, leaf_h, leaf_c, active)
+    cand = active & (res.gain > 0.0) & (res.gain > NEG_INF / 2)
+    key = jnp.where(cand, res.gain, -jnp.inf)
+    order = jnp.argsort(-key)
+    rank = jnp.zeros(L, jnp.int32).at[order].set(leaves_iota)
+    sel = cand & (rank < SLOTS - 1)
+    idx_in_lvl = (jnp.cumsum(sel.astype(jnp.int32)) - 1).astype(jnp.int32)
+    new_leaf = 127 + idx_in_lvl
+    lg, lh, lc = res.left_g, res.left_h, res.left_cnt
+    rg, rh, rc = leaf_g - lg, leaf_h - lh, leaf_c - lc
+    small_is_left = lc <= rc
+    tables = H.RouteTables(
+        feat=jnp.where(sel, res.feature, -1), thr=res.bin,
+        dleft=res.default_left.astype(jnp.int32), new_leaf=new_leaf,
+        slot_left=jnp.where(sel & small_is_left, idx_in_lvl, SLOTS),
+        slot_right=jnp.where(sel & ~small_is_left, idx_in_lvl, SLOTS))
+    hist_small, leaf_id2 = H.hist_routed(
+        bins, g, h, c, leaf_id0, tables, na_bin, SLOTS, B, "onehot")
+    leaf_of_slot = _scatter_set(jnp.full(SLOTS, _OOB, jnp.int32),
+                                idx_in_lvl, leaves_iota, sel)
+    slot_used = leaf_of_slot < L
+    parent_hist = st_hist[jnp.minimum(leaf_of_slot, L - 1)]
+    hist_sib = parent_hist - hist_small
+    sl = small_is_left[jnp.minimum(leaf_of_slot, L - 1)][:, None, None, None]
+    hist_left = jnp.where(sl, hist_small, hist_sib)
+    hist_right = jnp.where(sl, hist_sib, hist_small)
+    new_leaf_of_slot = _scatter_set(jnp.full(SLOTS, _OOB, jnp.int32),
+                                    idx_in_lvl, new_leaf, sel)
+    hist2 = st_hist.at[jnp.where(slot_used, leaf_of_slot, _OOB)].set(
+        hist_left, mode="drop")
+    hist2 = hist2.at[jnp.where(slot_used, new_leaf_of_slot, _OOB)].set(
+        hist_right, mode="drop")
+    return hist2.sum() + leaf_id2.sum().astype(jnp.float32)
+
+
+def hist_only(s):
+    tables = H.RouteTables(
+        feat=jnp.zeros(L, jnp.int32), thr=jnp.full(L, 31, jnp.int32),
+        dleft=jnp.zeros(L, jnp.int32), new_leaf=jnp.arange(L, dtype=jnp.int32),
+        slot_left=jnp.zeros(L, jnp.int32), slot_right=jnp.ones(L, jnp.int32))
+    hs, lid2 = H.hist_routed(bins, g * s, h, c, leaf_id0, tables, na_bin,
+                             SLOTS, B, "onehot")
+    return hs.sum() + lid2.sum().astype(jnp.float32)
+
+
+def bookkeeping_only(s):
+    st_hist = hist_state * s
+    res = jax.vmap(lambda hh, g_, h_, c_, a_: best_split(
+        hh, num_bins, na_bin, g_, h_, c_, fmask, sp, a_)
+    )(st_hist, leaf_g, leaf_h, leaf_c, active)
+    cand = active & (res.gain > 0.0)
+    key = jnp.where(cand, res.gain, -jnp.inf)
+    order = jnp.argsort(-key)
+    rank = jnp.zeros(L, jnp.int32).at[order].set(leaves_iota)
+    sel = cand & (rank < SLOTS - 1)
+    idx_in_lvl = (jnp.cumsum(sel.astype(jnp.int32)) - 1).astype(jnp.int32)
+    leaf_of_slot = _scatter_set(jnp.full(SLOTS, _OOB, jnp.int32),
+                                idx_in_lvl, leaves_iota, sel)
+    parent_hist = st_hist[jnp.minimum(leaf_of_slot, L - 1)]
+    hist_sib = parent_hist - hist_state[:SLOTS]
+    hist2 = st_hist.at[jnp.where(leaf_of_slot < L, leaf_of_slot, _OOB)].set(
+        hist_sib, mode="drop")
+    return hist2.sum()
+
+
+t_loop("level() complete (S=128)", one_level)
+t_loop("hist_routed only (S=128)", hist_only)
+t_loop("bookkeeping only (best_split+state)", bookkeeping_only)
+
+# whole grower for reference
+f_grow = jax.jit(lambda s: grow_tree_depthwise(
+    bins, g * s, h, c, num_bins, na_bin, fmask, gp)[0].leaf_value.sum())
+t_loop("grow_tree_depthwise whole", f_grow, K=3)

@@ -1,0 +1,136 @@
+"""Battery over the previously-untested objective/metric paths (VERDICT r1
+weak #4): ranking (lambdarank/xendcg), quantile pinball, poisson/gamma/tweedie
+on positive targets, mape, and their metrics."""
+import numpy as np
+import pytest
+
+import lightgbm_tpu as lgb
+from lightgbm_tpu.metrics import create_metrics
+from lightgbm_tpu.config import Config
+
+import jax.numpy as jnp
+
+
+def _rank_problem(n_query=60, docs_per_q=12, n_feat=6, seed=0):
+    """Synthetic LTR: relevance depends on features; queries equal-sized."""
+    rng = np.random.RandomState(seed)
+    n = n_query * docs_per_q
+    X = rng.randn(n, n_feat)
+    w = rng.randn(n_feat)
+    util = X @ w + 0.3 * rng.randn(n)
+    # per-query relevance grades 0..4 by ranking the utility within the query
+    label = np.zeros(n)
+    for q in range(n_query):
+        s = slice(q * docs_per_q, (q + 1) * docs_per_q)
+        order = np.argsort(np.argsort(util[s]))
+        label[s] = np.minimum(4, order // (docs_per_q // 5))
+    group = np.full(n_query, docs_per_q)
+    return X, label, group
+
+
+def _ndcg_at(k, label, pred, group):
+    """Simple numpy NDCG@k reference."""
+    out = []
+    start = 0
+    for g in group:
+        l = label[start:start + g]
+        p = pred[start:start + g]
+        order = np.argsort(-p)
+        gains = (2.0 ** l[order][:k] - 1) / np.log2(np.arange(2, min(k, g) + 2))
+        ideal = np.sort(l)[::-1]
+        igains = (2.0 ** ideal[:k] - 1) / np.log2(np.arange(2, min(k, g) + 2))
+        out.append(gains.sum() / igains.sum() if igains.sum() > 0 else 1.0)
+        start += g
+    return float(np.mean(out))
+
+
+@pytest.mark.parametrize("objective", ["lambdarank", "rank_xendcg"])
+def test_ranking_objectives_learn(objective):
+    X, label, group = _rank_problem()
+    ds = lgb.Dataset(X, label=label, group=group)
+    bst = lgb.train({"objective": objective, "num_leaves": 15, "verbosity": -1,
+                     "min_data_in_leaf": 5, "learning_rate": 0.1,
+                     "metric": "ndcg", "ndcg_eval_at": [5]},
+                    ds, num_boost_round=30)
+    pred = np.asarray(bst.predict(X))
+    ndcg = _ndcg_at(5, label, pred, group)
+    base = _ndcg_at(5, label, np.zeros_like(pred) + np.random.RandomState(1).rand(len(pred)), group)
+    assert ndcg > 0.85, f"{objective} NDCG@5 {ndcg} too low"
+    assert ndcg > base + 0.1
+
+
+def test_ndcg_metric_matches_numpy():
+    X, label, group = _rank_problem(seed=3)
+    pred = np.random.RandomState(0).randn(len(label))
+    m = create_metrics(["ndcg"], Config({"ndcg_eval_at": [5]}))[0]
+    val = m(jnp.asarray(label), jnp.asarray(pred), None, jnp.asarray(group))
+    ref = _ndcg_at(5, label, pred, group)
+    assert abs(float(val) - ref) < 1e-3
+
+
+def test_quantile_pinball():
+    """alpha-quantile objective must roughly hit the alpha coverage."""
+    rng = np.random.RandomState(0)
+    n = 3000
+    X = rng.randn(n, 4)
+    y = X[:, 0] * 2 + rng.randn(n) * (1.0 + 0.5 * np.abs(X[:, 1]))
+    for alpha in (0.1, 0.9):
+        ds = lgb.Dataset(X, label=y)
+        bst = lgb.train({"objective": "quantile", "alpha": alpha,
+                         "num_leaves": 15, "verbosity": -1,
+                         "min_data_in_leaf": 20},
+                        ds, num_boost_round=60)
+        pred = np.asarray(bst.predict(X))
+        coverage = float((y <= pred).mean())
+        assert abs(coverage - alpha) < 0.08, f"alpha={alpha}: coverage={coverage}"
+
+
+@pytest.mark.parametrize("objective,metric", [("poisson", "poisson"),
+                                              ("gamma", "gamma"),
+                                              ("tweedie", "tweedie")])
+def test_positive_regression_objectives(objective, metric):
+    rng = np.random.RandomState(0)
+    n = 2000
+    X = rng.randn(n, 4)
+    mu = np.exp(0.5 * X[:, 0] - 0.3 * X[:, 1])
+    if objective == "poisson":
+        y = rng.poisson(mu).astype(np.float64)
+    else:
+        y = mu * (0.5 + rng.rand(n))  # positive continuous
+    ds = lgb.Dataset(X, label=y)
+    bst = lgb.train({"objective": objective, "num_leaves": 15, "verbosity": -1,
+                     "min_data_in_leaf": 20, "metric": metric},
+                    ds, num_boost_round=40)
+    pred = np.asarray(bst.predict(X))
+    assert (pred > 0).all(), f"{objective} predictions must be positive"
+    # predictions correlate with the true rate
+    corr = np.corrcoef(pred, mu)[0, 1]
+    assert corr > 0.7, f"{objective}: corr {corr}"
+
+
+def test_mape_objective():
+    rng = np.random.RandomState(0)
+    n = 2000
+    X = rng.randn(n, 4)
+    y = np.exp(X[:, 0]) + 1.0
+    ds = lgb.Dataset(X, label=y)
+    bst = lgb.train({"objective": "mape", "num_leaves": 15, "verbosity": -1},
+                    ds, num_boost_round=40)
+    pred = np.asarray(bst.predict(X))
+    mape = float(np.mean(np.abs((y - pred) / y)))
+    assert mape < 0.35, f"mape {mape}"
+
+
+def test_fair_and_huber():
+    rng = np.random.RandomState(0)
+    n = 1500
+    X = rng.randn(n, 4)
+    y = X[:, 0] * 3 + rng.randn(n) * 0.2
+    y[::50] += 30  # outliers: robust losses must not blow up
+    for obj in ("huber", "fair"):
+        ds = lgb.Dataset(X, label=y)
+        bst = lgb.train({"objective": obj, "num_leaves": 15, "verbosity": -1},
+                        ds, num_boost_round=40)
+        pred = np.asarray(bst.predict(X))
+        med_err = float(np.median(np.abs(pred - X[:, 0] * 3)))
+        assert med_err < 1.0, f"{obj}: median error {med_err}"

@@ -1,0 +1,121 @@
+"""TreeSHAP correctness vs brute-force Shapley values, and model_to_cpp
+compiled-vs-predicted parity (reference: tests/cpp_test/test.py does the same
+compile-and-compare)."""
+import itertools
+import math
+import os
+import subprocess
+import tempfile
+
+import numpy as np
+import pytest
+
+import lightgbm_tpu as lgb
+
+
+def _expvalue(tree, x, fixed):
+    """E[f(x')|x'_S = x_S] with coverage-weighted marginalization."""
+    def rec(ptr):
+        if ptr < 0:
+            return tree.leaf_value[~ptr]
+        feat = tree.split_feature[ptr]
+        l, r = tree.left_child[ptr], tree.right_child[ptr]
+        def cnt(p):
+            return (tree.leaf_count[~p] if p < 0
+                    else tree.internal_count[p]).astype(float)
+        if feat in fixed:
+            go_left = x[feat] <= tree.threshold_real[ptr]
+            return rec(l if go_left else r)
+        total = cnt(l) + cnt(r)
+        return (cnt(l) * rec(l) + cnt(r) * rec(r)) / total
+    return rec(0)
+
+
+def _brute_shap(tree, x, n_feat):
+    """Exact Shapley values by subset enumeration."""
+    phi = np.zeros(n_feat + 1)
+    feats = list(range(n_feat))
+    for j in feats:
+        others = [f for f in feats if f != j]
+        for k in range(len(others) + 1):
+            for S in itertools.combinations(others, k):
+                w = (math.factorial(k) * math.factorial(n_feat - k - 1)
+                     / math.factorial(n_feat))
+                phi[j] += w * (_expvalue(tree, x, set(S) | {j})
+                               - _expvalue(tree, x, set(S)))
+    phi[-1] = _expvalue(tree, x, set())
+    return phi
+
+
+def test_treeshap_matches_bruteforce():
+    rng = np.random.RandomState(0)
+    n, f = 400, 4
+    X = rng.randn(n, f)
+    y = X[:, 0] * 2 + X[:, 1] * X[:, 2] + rng.randn(n) * 0.1
+    bst = lgb.train({"objective": "regression", "num_leaves": 8,
+                     "verbosity": -1, "min_data_in_leaf": 10,
+                     "lambda_l2": 1.0},   # l2 active: tests the base value too
+                    lgb.Dataset(X, label=y), num_boost_round=3)
+    trees = bst._ensure_host_trees()
+    contrib = np.asarray(bst.predict(X[:5], pred_contrib=True))
+    for i in range(5):
+        ref = np.zeros(f + 1)
+        for t in trees:
+            ref += _brute_shap(t, X[i], f)
+        np.testing.assert_allclose(contrib[i], ref, rtol=1e-5, atol=1e-6)
+
+
+def test_shap_sums_to_prediction():
+    """Contributions must sum to the raw prediction (reference guarantee;
+    ADVICE r1 low #2: broken under lambda_l2 before the base-value fix)."""
+    rng = np.random.RandomState(1)
+    X = rng.randn(500, 5)
+    y = (X[:, 0] + X[:, 1] > 0).astype(float)
+    bst = lgb.train({"objective": "binary", "num_leaves": 15, "verbosity": -1,
+                     "lambda_l2": 5.0, "min_data_in_leaf": 10},
+                    lgb.Dataset(X, label=y), num_boost_round=10)
+    contrib = np.asarray(bst.predict(X[:50], pred_contrib=True))
+    raw = np.asarray(bst.predict(X[:50], raw_score=True))
+    np.testing.assert_allclose(contrib.sum(axis=1), raw, rtol=1e-4, atol=1e-5)
+
+
+def test_model_to_cpp_compiles_and_matches():
+    """Generate C++ from a model, compile with g++, compare predictions
+    (reference: tests/cpp_test/test.py + predict.cpp)."""
+    rng = np.random.RandomState(2)
+    X = rng.randn(300, 4)
+    y = X[:, 0] - 2 * X[:, 1] + rng.randn(300) * 0.1
+    bst = lgb.train({"objective": "regression", "num_leaves": 7,
+                     "verbosity": -1, "min_data_in_leaf": 5},
+                    lgb.Dataset(X, label=y), num_boost_round=5)
+    from lightgbm_tpu.io.model_text import model_to_cpp
+    code = model_to_cpp(bst, bst._ensure_host_trees())
+    with tempfile.TemporaryDirectory() as td:
+        src = os.path.join(td, "model.cpp")
+        main_src = os.path.join(td, "main.cpp")
+        exe = os.path.join(td, "pred")
+        with open(src, "w") as fh:
+            fh.write(code)
+        with open(main_src, "w") as fh:
+            fh.write("""
+#include <cstdio>
+void Predict(const double* features, double* output);
+int main() {
+  double row[4];
+  double out[1];
+  while (scanf("%lf %lf %lf %lf", &row[0], &row[1], &row[2], &row[3]) == 4) {
+    Predict(row, out);
+    printf("%.17g\\n", out[0]);
+  }
+  return 0;
+}
+""")
+        subprocess.run(["g++", "-O1", "-o", exe, src, main_src], check=True)
+        inp = "\n".join(" ".join(f"{v:.17g}" for v in row) for row in X[:64])
+        out = subprocess.run([exe], input=inp, capture_output=True, text=True,
+                             check=True)
+        cpp_pred = np.array([float(s) for s in out.stdout.split()])
+    # device ensemble accumulation is f32 (TPU has no native f64); the C++
+    # code is the f64 ground truth — parity at f32 resolution
+    np.testing.assert_allclose(cpp_pred, np.asarray(bst.predict(X[:64])),
+                               rtol=2e-5, atol=1e-6)
