@@ -124,8 +124,12 @@ def grow_tree(bins: jnp.ndarray, g: jnp.ndarray, h: jnp.ndarray, c: jnp.ndarray,
     sp = gp.split
 
     leaf_id = jnp.zeros(n, dtype=jnp.int32)
-    hist0 = _psum(H.hist_leaf(bins, g, h, c, B, gp.hist_impl), gp)     # [F, B, 3]
-    g0, h0, c0 = hist0[0, :, 0].sum(), hist0[0, :, 1].sum(), hist0[0, :, 2].sum()
+    # pallas kernels read a transposed bin matrix; build it ONCE per tree (XLA
+    # CSEs it across all histogram passes inside this jit)
+    bins_T = bins.T if H.pick_impl(gp.hist_impl) == "pallas" else None
+    hist0 = _psum(H.hist_leaf(bins, g, h, c, B, gp.hist_impl, bins_T=bins_T),
+                  gp)                                                  # [3, F, B]
+    g0, h0, c0 = hist0[0, 0].sum(), hist0[1, 0].sum(), hist0[2, 0].sum()
 
     best0 = best_split(hist0, num_bins, na_bin, g0, h0, c0, feature_mask, sp,
                        allow_split=_allow_depth(jnp.int32(0), gp) if gp.max_depth > 0 else True)
@@ -139,7 +143,7 @@ def grow_tree(bins: jnp.ndarray, g: jnp.ndarray, h: jnp.ndarray, c: jnp.ndarray,
         left_g=tile(best0.left_g, 0.0), left_h=tile(best0.left_h, 0.0),
         left_cnt=tile(best0.left_cnt, 0.0))
 
-    hist = jnp.zeros((L, f, B, 3), dtype=jnp.float32).at[0].set(hist0)
+    hist = jnp.zeros((L, 3, f, B), dtype=jnp.float32).at[0].set(hist0)
     state = _GrowState(
         leaf_id=leaf_id, hist=hist,
         leaf_g=jnp.zeros(L).at[0].set(g0),
@@ -179,7 +183,8 @@ def grow_tree(bins: jnp.ndarray, g: jnp.ndarray, h: jnp.ndarray, c: jnp.ndarray,
             small_leaf = jnp.where(small_is_left, l, new_leaf)
             mask = (leaf_id2 == small_leaf).astype(g.dtype)
             hist_small = _psum(
-                H.hist_leaf(bins, g * mask, h * mask, c * mask, B, gp.hist_impl),
+                H.hist_leaf(bins, g * mask, h * mask, c * mask, B, gp.hist_impl,
+                            bins_T=bins_T),
                 gp)
             hist_parent = st.hist[l]
             hist_large = hist_parent - hist_small
@@ -215,16 +220,15 @@ def grow_tree(bins: jnp.ndarray, g: jnp.ndarray, h: jnp.ndarray, c: jnp.ndarray,
                 num_leaves=tr.num_leaves + 1,
             )
 
-            # ---- best splits for the two children ----
+            # ---- best splits for the two children (batched, not vmapped) ----
             depth = st.leaf_depth[l] + 1
             allow = _allow_depth(depth, gp) if gp.max_depth > 0 else jnp.bool_(True)
-            ch_hist = jnp.stack([hist_left, hist_right])
+            ch_hist = jnp.stack([hist_left, hist_right])      # [2, 3, F, B]
             ch_g = jnp.stack([lg, rg])
             ch_h = jnp.stack([lh, rh])
             ch_c = jnp.stack([lc, rc])
-            bs = jax.vmap(lambda hh, g_, h_, c_: best_split(
-                hh, num_bins, na_bin, g_, h_, c_, feature_mask, sp, allow)
-            )(ch_hist, ch_g, ch_h, ch_c)
+            bs = best_split(ch_hist, num_bins, na_bin, ch_g, ch_h, ch_c,
+                            feature_mask, sp, allow)
 
             def upd(arr, vals):
                 return arr.at[l].set(vals[0]).at[new_leaf].set(vals[1])

@@ -41,7 +41,7 @@ _OOB = 1 << 20  # out-of-bounds scatter index (dropped with mode="drop")
 
 class _DWState(NamedTuple):
     leaf_id: jnp.ndarray      # [N]
-    hist: jnp.ndarray         # [L, F, B, 3] per-leaf histograms (frontier leaves)
+    hist: jnp.ndarray         # [L, 3, F, B] per-leaf histograms (frontier leaves)
     leaf_g: jnp.ndarray       # [L]
     leaf_h: jnp.ndarray
     leaf_c: jnp.ndarray
@@ -76,14 +76,18 @@ def grow_tree_depthwise(bins: jnp.ndarray, g: jnp.ndarray, h: jnp.ndarray,
     max_levels = gp.max_depth if gp.max_depth > 0 else max(1, L - 1)
     MAX_SLOTS = (L + 1) // 2 + 1 if L > 2 else 2  # max splits in one level + 1
 
-    hist0 = _psum(H.hist_leaf(bins, g, h, c, B, gp.hist_impl), gp)
-    g0 = hist0[0, :, 0].sum()
-    h0 = hist0[0, :, 1].sum()
-    c0 = hist0[0, :, 2].sum()
+    # pallas kernels read a transposed bin matrix; build it ONCE per tree (XLA
+    # CSEs it across all level passes inside this jit)
+    bins_T = bins.T if H.pick_impl(gp.hist_impl) == "pallas" else None
+    hist0 = _psum(H.hist_leaf(bins, g, h, c, B, gp.hist_impl, bins_T=bins_T),
+                  gp)                                                # [3, F, B]
+    g0 = hist0[0, 0].sum()
+    h0 = hist0[1, 0].sum()
+    c0 = hist0[2, 0].sum()
 
     state = _DWState(
         leaf_id=jnp.zeros(n, dtype=jnp.int32),
-        hist=jnp.zeros((L, f, B, 3), jnp.float32).at[0].set(hist0),
+        hist=jnp.zeros((L, 3, f, B), jnp.float32).at[0].set(hist0),
         leaf_g=jnp.zeros(L).at[0].set(g0),
         leaf_h=jnp.zeros(L).at[0].set(h0),
         leaf_c=jnp.zeros(L).at[0].set(c0),
@@ -102,10 +106,9 @@ def grow_tree_depthwise(bins: jnp.ndarray, g: jnp.ndarray, h: jnp.ndarray,
     leaves_iota = jnp.arange(L, dtype=jnp.int32)
 
     def level(st: _DWState, SLOTS: int):
-        # ---- best split for every frontier leaf (vectorized over L) ----
-        res = jax.vmap(lambda hh, g_, h_, c_, a_: best_split(
-            hh, num_bins, na_bin, g_, h_, c_, feature_mask, sp, a_)
-        )(st.hist, st.leaf_g, st.leaf_h, st.leaf_c, st.active)
+        # ---- best split for every frontier leaf (one batched kernel) ----
+        res = best_split(st.hist, num_bins, na_bin, st.leaf_g, st.leaf_h,
+                         st.leaf_c, feature_mask, sp, st.active)
 
         # ---- budgeted selection (num_leaves cap): top-gain candidates win ----
         cand = st.active & (res.gain > jnp.maximum(sp.min_gain_to_split, 0.0)) \
@@ -174,7 +177,8 @@ def grow_tree_depthwise(bins: jnp.ndarray, g: jnp.ndarray, h: jnp.ndarray,
             slot_right=jnp.where(sel & ~small_is_left, idx_in_lvl, SLOTS),
         )
         hist_small, leaf_id2 = H.hist_routed(
-            bins, g, h, c, st.leaf_id, tables, na_bin, SLOTS, B, gp.hist_impl)
+            bins, g, h, c, st.leaf_id, tables, na_bin, SLOTS, B, gp.hist_impl,
+            bins_T=bins_T)
         hist_small = _psum(hist_small, gp)
 
         leaf_of_slot = _scatter_set(jnp.full(SLOTS, _OOB, jnp.int32),

@@ -1,0 +1,151 @@
+"""Pallas TPU histogram kernel.
+
+Hand-written replacement for the XLA ``onehot`` formulation in ops/histogram.py
+(reference hot loop: DenseBin::ConstructHistogramInner, dense_bin.hpp:77-105;
+GPU ports: src/treelearner/ocl/histogram256.cl). Design (SURVEY §7):
+
+- grid over (feature-group, row-chunk); the f32 accumulator block
+  ``[Fg*B, S*6]`` stays resident in VMEM across the row-chunk axis;
+- the bin one-hot is built DIRECTLY in ``[F*B, C]`` lane layout from a
+  pre-transposed ``[F, N]`` bin matrix: a sublane-broadcast plus a
+  ``broadcasted_iota`` compare — pure VPU work, no expansion matmul and no
+  minor-dim reshape (the two relayout hazards of the XLA path);
+- the per-row channel weights are built in ``[S*6, C]`` lane layout (rows =
+  slot x channel, columns = rows-of-data) so the MXU contraction
+  ``onehot [F*B, C] x w [S*6, C]^T`` contracts the lane axis of both operands
+  — no transposes anywhere;
+- grad/hess are split hi/lo into two bf16 channels each (f32-accurate MXU
+  accumulation, see ops/histogram.py _split_hi_lo_tile).
+
+The kernel serves both the root pass (S=1, all rows in slot 0) and the
+depthwise level pass (S slots routed by ops/histogram.py route_level).
+"""
+from __future__ import annotations
+
+import functools
+from typing import Tuple
+
+import jax
+import jax.numpy as jnp
+from jax.experimental import pallas as pl
+from jax.experimental.pallas import tpu as pltpu
+
+_CHUNK = 1024          # rows per grid step (onehot block [F*B, C] bf16 ~3.7MB)
+_ACC_ROWS_MAX = 2048   # Fg*B cap: keeps the f32 accumulator block <= ~6.3MB
+
+
+def _kernel(bins_ref, g_ref, h_ref, c_ref, slot_ref, out_ref, *,
+            fg: int, b: int, s: int, chunk: int):
+    """One (feature-group j, row-chunk i) grid step.
+
+    bins_ref: [Fg, C] uint8 (transposed bins); g/h/c_ref: [C] f32;
+    slot_ref: [C] i32; out_ref: [Fg*B, S*6] f32 accumulated across i.
+    """
+    i = pl.program_id(1)
+
+    @pl.when(i == 0)
+    def _():
+        out_ref[:] = jnp.zeros_like(out_ref)
+
+    # ---- one-hot in [Fg*B, C] lane layout: VPU only ----
+    bins_i = bins_ref[:].astype(jnp.int32)                      # [Fg, C]
+    bb = jax.lax.broadcast_in_dim(bins_i, (fg, b, chunk), (0, 2))
+    iota_b = jax.lax.broadcasted_iota(jnp.int32, (fg, b, chunk), 1)
+    onehot = (bb == iota_b).astype(jnp.bfloat16).reshape(fg * b, chunk)
+
+    # ---- weights in [S*6, C] lane layout ----
+    g = g_ref[:].reshape(1, chunk)
+    h = h_ref[:].reshape(1, chunk)
+    c = c_ref[:].reshape(1, chunk)
+    ghc = jnp.concatenate([g, h, c], axis=0)                    # [3, C] f32
+    hi = ghc.astype(jnp.bfloat16)
+    lo = (ghc - hi.astype(jnp.float32)).astype(jnp.bfloat16)
+    ghc6 = jnp.concatenate([hi, lo], axis=0)                    # [6, C]
+    w = jax.lax.broadcast_in_dim(ghc6, (s, 6, chunk), (1, 2)) \
+        .reshape(s * 6, chunk)                                  # [S*6, C]
+    slot = slot_ref[:].reshape(1, chunk)
+    slot_of_row = jax.lax.broadcasted_iota(jnp.int32, (s * 6, chunk), 0) // 6
+    w = jnp.where(slot == slot_of_row, w, jnp.bfloat16(0.0))
+
+    # ---- MXU: contract the lane (row) axis of both operands ----
+    part = jax.lax.dot_general(
+        onehot, w, dimension_numbers=(((1,), (1,)), ((), ())),
+        preferred_element_type=jnp.float32)                     # [Fg*B, S*6]
+    out_ref[:] += part
+
+
+def _pad_rows(x, mult, value=0):
+    n = x.shape[-1] if x.ndim == 2 else x.shape[0]
+    pad = (-n) % mult
+    if pad == 0:
+        return x
+    if x.ndim == 2:
+        return jnp.pad(x, ((0, 0), (0, pad)), constant_values=value)
+    return jnp.pad(x, (0, pad), constant_values=value)
+
+
+def hist_pallas(bins_T: jnp.ndarray, g: jnp.ndarray, h: jnp.ndarray,
+                c: jnp.ndarray, slot: jnp.ndarray, num_slots: int,
+                num_bins: int, chunk: int = _CHUNK,
+                interpret: bool = False) -> jnp.ndarray:
+    """Slot-routed histogram: returns [S, 3, F, B] f32 (channel-major).
+
+    bins_T: [F, N] uint8 (bins transposed — dataset-resident, built once);
+    g/h/c: [N] f32 channels (zero for out-of-bag rows);
+    slot: [N] i32 in [0, num_slots); rows with slot >= num_slots are dropped.
+    """
+    f, n = bins_T.shape
+    b, s = num_bins, num_slots
+
+    fg = max(1, min(f, _ACC_ROWS_MAX // b))
+    n_fg = -(-f // fg)
+    f_pad = n_fg * fg
+    if f_pad != f:
+        bins_T = jnp.pad(bins_T, ((0, f_pad - f), (0, 0)))
+
+    bins_T = _pad_rows(bins_T, chunk)
+    g = _pad_rows(g, chunk)
+    h = _pad_rows(h, chunk)
+    c = _pad_rows(c, chunk)
+    # padded rows carry zero channels; droppped slots (>= s) become s below
+    slot = _pad_rows(slot, chunk, value=s)
+    slot = jnp.minimum(slot, s)  # anything out of range masks to zero weight
+    n_chunks = bins_T.shape[1] // chunk
+
+    kern = functools.partial(_kernel, fg=fg, b=b, s=s, chunk=chunk)
+    out = pl.pallas_call(
+        kern,
+        grid=(n_fg, n_chunks),
+        in_specs=[
+            pl.BlockSpec((fg, chunk), lambda j, i: (j, i),
+                         memory_space=pltpu.VMEM),
+            pl.BlockSpec((chunk,), lambda j, i: (i,),
+                         memory_space=pltpu.VMEM),
+            pl.BlockSpec((chunk,), lambda j, i: (i,),
+                         memory_space=pltpu.VMEM),
+            pl.BlockSpec((chunk,), lambda j, i: (i,),
+                         memory_space=pltpu.VMEM),
+            pl.BlockSpec((chunk,), lambda j, i: (i,),
+                         memory_space=pltpu.VMEM),
+        ],
+        out_specs=pl.BlockSpec((fg * b, s * 6), lambda j, i: (j, 0),
+                               memory_space=pltpu.VMEM),
+        out_shape=jax.ShapeDtypeStruct((f_pad * b, s * 6), jnp.float32),
+        cost_estimate=pl.CostEstimate(
+            flops=2 * n * f_pad * b * s * 6,
+            bytes_accessed=n * (f_pad + 16) + f_pad * b * s * 24,
+            transcendentals=0),
+        interpret=interpret,
+    )(bins_T, g, h, c, slot)
+
+    # [F_pad*B, S*6] -> [S, 3, F, B] (hi+lo recombined), drop padded features
+    out = out.reshape(f_pad, b, s, 2, 3).sum(axis=3).transpose(2, 3, 0, 1)
+    return out[:, :, :f, :]
+
+
+def hist_leaf_pallas(bins_T, g, h, c, num_bins: int,
+                     interpret: bool = False) -> jnp.ndarray:
+    """Root histogram pass: [3, F, B] f32."""
+    slot = jnp.zeros(bins_T.shape[1], jnp.int32)
+    return hist_pallas(bins_T, g, h, c, slot, 1, num_bins,
+                       interpret=interpret)[0]

@@ -11,6 +11,11 @@ threshold, both missing-direction variants are evaluated as two stacked planes, 
 single masked argmax picks the best (feature, bin, default_left) triple — so split
 selection runs entirely on device (the reference's GPU learner ships histograms back
 to the host for this step; we don't).
+
+The search is natively BATCHED over a leading leaf axis ([L, 3, F, B] histograms
+-> [L] split results, all ops whole-array) rather than vmapped per leaf: one
+fused kernel over the whole frontier replaces L small latency-bound kernels.
+Histograms are channel-major [3, F, B] (see ops/histogram.py layout rules).
 """
 from __future__ import annotations
 
@@ -37,7 +42,7 @@ class SplitParams:
 class SplitResult(NamedTuple):
     """Best split for one leaf (reference analog: SplitInfo, split_info.hpp:22).
 
-    All fields are scalars (or batched leading dims under vmap)."""
+    All fields are scalars (or share the batched leading dims of the input)."""
     gain: jnp.ndarray          # improvement: gain_l + gain_r - gain_parent; NEG_INF if none
     feature: jnp.ndarray       # i32
     bin: jnp.ndarray           # i32 threshold bin (go left if bin <= threshold)
@@ -77,67 +82,82 @@ def best_split(hist: jnp.ndarray, num_bins: jnp.ndarray, na_bin: jnp.ndarray,
                parent_g, parent_h, parent_cnt,
                feature_mask: jnp.ndarray, p: SplitParams,
                allow_split=True) -> SplitResult:
-    """Find the best split for one leaf.
+    """Find the best split for one leaf or a whole frontier of leaves.
 
-    hist: [F, B, 3] (grad, hess, count); num_bins: [F] i32 actual bins per feature;
-    na_bin: [F] i32 missing-bin index or -1; feature_mask: [F] bool;
-    allow_split: scalar bool (e.g. depth limit reached -> no split).
+    hist: [..., 3, F, B] channel-major (grad, hess, count); num_bins: [F] i32
+    actual bins per feature; na_bin: [F] i32 missing-bin index (or >= B if
+    none); feature_mask: [F] bool; parent_g/h/cnt and allow_split broadcast
+    over the leading batch dims of hist.
     """
-    f, b, _ = hist.shape
-    iota = jnp.arange(b, dtype=jnp.int32)[None, :]            # [1, B]
-    na = na_bin[:, None]                                      # [F, 1]
+    batch_shape = hist.shape[:-3]
+    _, f, b = hist.shape[-3:]
+    L = 1
+    for d in batch_shape:
+        L *= d
+    h3 = hist.reshape(L, 3, f, b)
+    pg = jnp.broadcast_to(jnp.asarray(parent_g, jnp.float32), batch_shape).reshape(L)
+    ph = jnp.broadcast_to(jnp.asarray(parent_h, jnp.float32), batch_shape).reshape(L)
+    pc = jnp.broadcast_to(jnp.asarray(parent_cnt, jnp.float32), batch_shape).reshape(L)
+    allow = jnp.broadcast_to(jnp.asarray(allow_split, bool), batch_shape).reshape(L)
 
-    # stats of the missing bin, excluded from the ordered scan and attached wholly
-    # to one side (reference scans both directions for the same effect,
+    iota = jnp.arange(b, dtype=jnp.int32)[None, None, :]          # [1, 1, B]
+    na = na_bin[None, :, None]                                    # [1, F, 1]
+
+    # stats of the missing bin, excluded from the ordered scan and attached
+    # wholly to one side (reference scans both directions for the same effect,
     # feature_histogram.hpp:527+)
-    na_sel = (iota == na)                                     # [F, B]
-    na_stats = jnp.sum(jnp.where(na_sel[:, :, None], hist, 0.0), axis=1)  # [F, 3]
-    scan_hist = jnp.where(na_sel[:, :, None], 0.0, hist)
-    cum = jnp.cumsum(scan_hist, axis=1)                       # [F, B, 3] left stats
+    na_sel = (iota == na)                                         # [1, F, B]
+    na_stats = jnp.sum(jnp.where(na_sel[:, None, :, :], h3, 0.0), axis=3)  # [L,3,F]
+    cum = jnp.cumsum(jnp.where(na_sel[:, None, :, :], 0.0, h3), axis=3)    # [L,3,F,B]
 
-    total = jnp.stack([parent_g, parent_h, parent_cnt])       # [3]
-
-    def variant(left):                                        # left: [F, B, 3]
-        lg, lh, lc = left[..., 0], left[..., 1], left[..., 2]
-        rg, rh, rc = total[0] - lg, total[1] - lh, total[2] - lc
+    def gains_of(left_shift):
+        """left_shift: [L,3,F,1] added to cum (the missing-left variant)."""
+        lg = cum[:, 0] + left_shift[:, 0]
+        lh = cum[:, 1] + left_shift[:, 1]
+        lc = cum[:, 2] + left_shift[:, 2]
+        rg = pg[:, None, None] - lg
+        rh = ph[:, None, None] - lh
+        rc = pc[:, None, None] - lc
         ok = ((lc >= p.min_data_in_leaf) & (rc >= p.min_data_in_leaf)
-              & (lh >= p.min_sum_hessian_in_leaf) & (rh >= p.min_sum_hessian_in_leaf))
+              & (lh >= p.min_sum_hessian_in_leaf)
+              & (rh >= p.min_sum_hessian_in_leaf))
         gain = leaf_split_gain(lg, lh, p) + leaf_split_gain(rg, rh, p)
-        return jnp.where(ok, gain, NEG_INF), left
+        return jnp.where(ok, gain, NEG_INF)
 
-    gain_r, left_r = variant(cum)                             # missing -> right
-    gain_l, left_l = variant(cum + na_stats[:, None, :])      # missing -> left
+    zeros = jnp.zeros((L, 3, f, 1), jnp.float32)
+    gain_r = gains_of(zeros)                                     # missing -> right
+    gain_l = gains_of(na_stats[..., None])                       # missing -> left
 
-    valid_t = (iota < num_bins[:, None] - 1) & (iota != na) & feature_mask[:, None]
-    has_na = (na >= 0)
+    valid_t = (iota < num_bins[None, :, None] - 1) & (~na_sel) \
+        & feature_mask[None, :, None]                            # [1, F, B]
+    has_na = na < b
     gain_r = jnp.where(valid_t, gain_r, NEG_INF)
-    # default-left variant only differs when a missing bin exists
     gain_l = jnp.where(valid_t & has_na, gain_l, NEG_INF)
 
-    gains = jnp.stack([gain_r, gain_l])                       # [2, F, B]
-    flat_idx = jnp.argmax(gains.reshape(-1))
-    d, rem = flat_idx // (f * b), flat_idx % (f * b)
-    feat, tbin = rem // b, rem % b
+    gains = jnp.concatenate([gain_r.reshape(L, f * b),
+                             gain_l.reshape(L, f * b)], axis=1)  # [L, 2FB]
+    flat = jnp.argmax(gains, axis=1)
+    best_gain = jnp.take_along_axis(gains, flat[:, None], axis=1)[:, 0]
+    d = flat // (f * b)
+    rem = flat % (f * b)
+    feat = (rem // b).astype(jnp.int32)
+    tbin = (rem % b).astype(jnp.int32)
 
-    best_gain = gains.reshape(-1)[flat_idx]
-    parent_gain = leaf_split_gain(total[0], total[1], p)
+    lidx = jnp.arange(L)
+    def pick(chan):
+        base = cum[lidx, chan, feat, tbin]
+        return base + jnp.where(d == 1, na_stats[lidx, chan, feat], 0.0)
+
+    parent_gain = leaf_split_gain(pg, ph, p)
     improvement = best_gain - parent_gain
-    found = allow_split & (best_gain > NEG_INF / 2) & (improvement > p.min_gain_to_split) \
-        & (improvement > 0.0)
+    found = allow & (best_gain > NEG_INF / 2) \
+        & (improvement > p.min_gain_to_split) & (improvement > 0.0)
 
-    left = jnp.where(d == 0, left_r[feat, tbin], left_l[feat, tbin])  # [3]
-    return SplitResult(
+    res = SplitResult(
         gain=jnp.where(found, improvement, NEG_INF),
-        feature=feat.astype(jnp.int32),
-        bin=tbin.astype(jnp.int32),
+        feature=feat,
+        bin=tbin,
         default_left=(d == 1),
-        left_g=left[0], left_h=left[1], left_cnt=left[2],
+        left_g=pick(0), left_h=pick(1), left_cnt=pick(2),
     )
-
-
-def best_split_batch(hist, num_bins, na_bin, parent_g, parent_h, parent_cnt,
-                     feature_mask, p: SplitParams, allow_split):
-    """Batched over a leading leaf axis: hist [L, F, B, 3], parents [L]."""
-    fn = lambda h, g, hh, c, a: best_split(h, num_bins, na_bin, g, hh, c,
-                                           feature_mask, p, a)
-    return jax.vmap(fn)(hist, parent_g, parent_h, parent_cnt, allow_split)
+    return SplitResult(*[v.reshape(batch_shape) for v in res])

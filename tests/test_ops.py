@@ -34,7 +34,7 @@ def test_hist_leaf_matches_numpy(impl):
     # VERDICT r1 weak #3)
     bins, g, h = _rand_problem()
     ghc = np.stack([g, h, np.ones_like(g)], axis=1)
-    ref = _np_hist(bins, ghc, 16)
+    ref = _np_hist(bins, ghc, 16).transpose(2, 0, 1)   # channel-major [3, F, B]
     out = np.asarray(H.hist_leaf(jnp.asarray(bins), jnp.asarray(g), jnp.asarray(h),
                                  jnp.ones(len(g), jnp.float32), 16, impl))
     np.testing.assert_allclose(out, ref, rtol=1e-4, atol=1e-4)
@@ -43,7 +43,7 @@ def test_hist_leaf_matches_numpy(impl):
 def test_hist_scatter_exact():
     bins, g, h = _rand_problem()
     ghc = np.stack([g, h, np.ones_like(g)], axis=1)
-    ref = _np_hist(bins, ghc, 16)
+    ref = _np_hist(bins, ghc, 16).transpose(2, 0, 1)
     out = np.asarray(H.hist_leaf(jnp.asarray(bins), jnp.asarray(g), jnp.asarray(h),
                                  jnp.ones(len(g), jnp.float32), 16, "scatter"))
     np.testing.assert_allclose(out, ref, rtol=1e-5, atol=1e-4)
@@ -59,6 +59,7 @@ def test_hist_per_leaf(impl):
     for i in range(300):
         for j in range(4):
             ref[leaf[i], j, bins[i, j]] += ghc[i]
+    ref = ref.transpose(0, 3, 1, 2)                    # [L, 3, F, B]
     out = np.asarray(H.hist_per_leaf(jnp.asarray(bins), jnp.asarray(g),
                                      jnp.asarray(h), jnp.ones(300, jnp.float32),
                                      jnp.asarray(leaf), 4, 16, impl))
@@ -111,7 +112,8 @@ def test_best_split_matches_bruteforce(l1, l2, seed):
                     min_sum_hessian_in_leaf=1e-3)
     ref_gain, ref_f, ref_t, ref_dl = _np_best_split(hist, num_bins, na_bin, p)
     total = hist[0].sum(axis=0)
-    res = best_split(jnp.asarray(hist, dtype=jnp.float32), jnp.asarray(num_bins),
+    res = best_split(jnp.asarray(hist.transpose(2, 0, 1), dtype=jnp.float32),
+                     jnp.asarray(num_bins),
                      jnp.asarray(np.where(na_bin < 0, 256, na_bin).astype(np.int32)),
                      total[0], total[1], total[2],
                      jnp.ones(3, dtype=bool), p, True)
@@ -184,3 +186,45 @@ def test_grow_tree_max_depth():
     tree, _ = grow_tree(jnp.asarray(bins), ghc[:, 0], ghc[:, 1], ghc[:, 2],
                         num_bins, na_bin, jnp.ones(4, dtype=bool), gp)
     assert int(tree.num_leaves) <= 4  # depth 2 -> at most 4 leaves
+
+
+# ---------------------------------------------------------------------------
+# pallas kernel (interpret mode — tests run on the CPU backend)
+# ---------------------------------------------------------------------------
+
+def test_hist_pallas_matches_scatter():
+    from lightgbm_tpu.ops.pallas_hist import hist_pallas
+    rng = np.random.RandomState(7)
+    n, f, b, s = 3000, 6, 16, 4
+    bins = rng.randint(0, b, size=(n, f)).astype(np.uint8)
+    g = rng.randn(n).astype(np.float32)
+    h = rng.rand(n).astype(np.float32)
+    c = np.ones(n, np.float32)
+    slot = rng.randint(0, s + 2, size=n).astype(np.int32)  # some out of range
+    keep = (slot < s)
+    ref = np.asarray(H.hist_per_leaf_scatter(
+        jnp.asarray(bins), jnp.asarray(g * keep), jnp.asarray(h * keep),
+        jnp.asarray(c * keep), jnp.asarray(np.where(keep, slot, s)), s, b))
+    out = np.asarray(hist_pallas(jnp.asarray(bins.T.copy()), jnp.asarray(g),
+                                 jnp.asarray(h), jnp.asarray(c),
+                                 jnp.asarray(slot), s, b, interpret=True))
+    np.testing.assert_allclose(out, ref, rtol=1e-4, atol=1e-3)
+
+
+def test_hist_pallas_feature_grouping():
+    """More features than one accumulator block: exercises the feature-group
+    grid axis (B=256 -> Fg=8)."""
+    from lightgbm_tpu.ops.pallas_hist import hist_pallas
+    rng = np.random.RandomState(8)
+    n, f, b = 500, 11, 256
+    bins = rng.randint(0, b, size=(n, f)).astype(np.uint8)
+    g = rng.randn(n).astype(np.float32)
+    h = rng.rand(n).astype(np.float32)
+    c = np.ones(n, np.float32)
+    slot = np.zeros(n, np.int32)
+    ref = np.asarray(H.hist_leaf_scatter(jnp.asarray(bins), jnp.asarray(g),
+                                         jnp.asarray(h), jnp.asarray(c), b))
+    out = np.asarray(hist_pallas(jnp.asarray(bins.T.copy()), jnp.asarray(g),
+                                 jnp.asarray(h), jnp.asarray(c),
+                                 jnp.asarray(slot), 1, b, interpret=True))[0]
+    np.testing.assert_allclose(out, ref, rtol=1e-4, atol=1e-3)
