@@ -13,6 +13,7 @@ import jax.numpy as jnp
 import numpy as np
 
 from ..ops import predict as P
+from ..ops.gather import take_small
 from ..utils import log
 from .gbdt import GBDT
 
@@ -78,7 +79,7 @@ class DART(GBDT):
                 tree_dev.split_feature, tree_dev.threshold_bin,
                 tree_dev.default_left, tree_dev.left_child, tree_dev.right_child,
                 tree_dev.num_leaves, bins, na_bin, max_steps)
-            delta = tree_dev.leaf_value[leaf] * sign
+            delta = take_small(tree_dev.leaf_value, leaf) * sign
             if k == 1:
                 return score + delta
             return score.at[:, cls].add(delta)

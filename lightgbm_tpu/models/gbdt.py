@@ -19,6 +19,7 @@ import jax.numpy as jnp
 import numpy as np
 
 from ..config import Config
+from ..ops.gather import take_small
 from ..ops.grow import GrowParams, TreeArrays, grow_tree
 from ..ops.split import SplitParams
 from ..ops import predict as P
@@ -224,7 +225,7 @@ class GBDT:
                 tree = tree._replace(
                     leaf_value=tree.leaf_value * shrink,
                     internal_value=tree.internal_value * shrink)
-                delta = tree.leaf_value[leaf_id]
+                delta = take_small(tree.leaf_value, leaf_id)
                 new_score = (new_score + delta if k == 1
                              else new_score.at[:, cls].add(delta))
                 trees.append((tree, leaf_id))
@@ -316,7 +317,7 @@ class GBDT:
                 tree_dev.split_feature, tree_dev.threshold_bin,
                 tree_dev.default_left, tree_dev.left_child, tree_dev.right_child,
                 tree_dev.num_leaves, vs.bins, vs.na_bin_dev, max_steps)
-            vdelta = tree_dev.leaf_value[leaf] - bias
+            vdelta = take_small(tree_dev.leaf_value, leaf) - bias
             if k == 1:
                 self.valid_scores[i] = self.valid_scores[i] + vdelta
             else:
@@ -399,7 +400,7 @@ class GBDT:
     def _update_scores(self, tree_dev: TreeArrays, leaf_id, cls: int) -> None:
         k = self.num_tree_per_iteration
         bias = self.init_scores[cls] if self.iter_ == 0 else 0.0
-        delta = tree_dev.leaf_value[leaf_id] - bias  # bias already added to scores
+        delta = take_small(tree_dev.leaf_value, leaf_id) - bias  # bias already added
         if k == 1:
             self.train_score = self.train_score + delta
         else:
@@ -410,7 +411,7 @@ class GBDT:
                 tree_dev.split_feature, tree_dev.threshold_bin,
                 tree_dev.default_left, tree_dev.left_child, tree_dev.right_child,
                 tree_dev.num_leaves, vs.bins, vs.na_bin_dev, max_steps)
-            vdelta = tree_dev.leaf_value[leaf] - bias
+            vdelta = take_small(tree_dev.leaf_value, leaf) - bias
             if k == 1:
                 self.valid_scores[i] = self.valid_scores[i] + vdelta
             else:
@@ -431,7 +432,7 @@ class GBDT:
                 tree_dev.split_feature, tree_dev.threshold_bin,
                 tree_dev.default_left, tree_dev.left_child, tree_dev.right_child,
                 tree_dev.num_leaves, ts.bins, ts.na_bin_dev, max_steps)
-            delta = tree_dev.leaf_value[leaf]
+            delta = take_small(tree_dev.leaf_value, leaf)
             if k == 1:
                 self.train_score = self.train_score - delta
             else:
@@ -441,7 +442,7 @@ class GBDT:
                     tree_dev.split_feature, tree_dev.threshold_bin,
                     tree_dev.default_left, tree_dev.left_child, tree_dev.right_child,
                     tree_dev.num_leaves, vs.bins, vs.na_bin_dev, max_steps)
-                vdelta = tree_dev.leaf_value[vleaf]
+                vdelta = take_small(tree_dev.leaf_value, vleaf)
                 if k == 1:
                     self.valid_scores[i] = self.valid_scores[i] - vdelta
                 else:
@@ -494,7 +495,7 @@ class GBDT:
                 tree_dev.split_feature, tree_dev.threshold_bin,
                 tree_dev.default_left, tree_dev.left_child, tree_dev.right_child,
                 tree_dev.num_leaves, bins, self.train_set.na_bin_dev, max_steps)
-            delta = tree_dev.leaf_value[leaf]
+            delta = take_small(tree_dev.leaf_value, leaf)
             out = out + delta if k == 1 else out.at[:, cls].add(delta)
         if self.average_output and self.models_dev:
             out = out / (len(self.models_dev) // k)

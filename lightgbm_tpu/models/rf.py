@@ -9,6 +9,7 @@ from __future__ import annotations
 import jax.numpy as jnp
 import numpy as np
 
+from ..ops.gather import take_small
 from ..utils import log
 from .gbdt import GBDT
 
@@ -52,7 +53,7 @@ class RF(GBDT):
         """Maintain scores as running averages (rf.hpp TrainOneIter)."""
         k = self.num_tree_per_iteration
         t = self.iter_ + 1  # trees per class after this one
-        delta = tree_dev.leaf_value[leaf_id]
+        delta = take_small(tree_dev.leaf_value, leaf_id)
         if k == 1:
             self.train_score = (self.train_score * (t - 1) + delta) / t
         else:
@@ -65,7 +66,7 @@ class RF(GBDT):
                 tree_dev.split_feature, tree_dev.threshold_bin,
                 tree_dev.default_left, tree_dev.left_child, tree_dev.right_child,
                 tree_dev.num_leaves, vs.bins, vs.na_bin_dev, max_steps)
-            vdelta = tree_dev.leaf_value[leaf]
+            vdelta = take_small(tree_dev.leaf_value, leaf)
             if k == 1:
                 self.valid_scores[i] = (self.valid_scores[i] * (t - 1) + vdelta) / t
             else:

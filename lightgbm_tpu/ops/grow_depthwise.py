@@ -110,13 +110,16 @@ def grow_tree_depthwise(bins: jnp.ndarray, g: jnp.ndarray, h: jnp.ndarray,
         res = best_split(st.hist, num_bins, na_bin, st.leaf_g, st.leaf_h,
                          st.leaf_c, feature_mask, sp, st.active)
 
-        # ---- budgeted selection (num_leaves cap): top-gain candidates win ----
+        # ---- budgeted selection (num_leaves cap): top-gain candidates win.
+        # rank by pairwise comparison count instead of argsort — an [L] sort
+        # on TPU costs milliseconds; the [L, L] compare matrix is microseconds
         cand = st.active & (res.gain > jnp.maximum(sp.min_gain_to_split, 0.0)) \
             & (res.gain > NEG_INF / 2)
         budget = L - st.tree.num_leaves
         key = jnp.where(cand, res.gain, -jnp.inf)
-        order = jnp.argsort(-key)
-        rank = jnp.zeros(L, jnp.int32).at[order].set(leaves_iota)
+        kj, ki = key[None, :], key[:, None]
+        better = (kj > ki) | ((kj == ki) & (leaves_iota[None, :] < leaves_iota[:, None]))
+        rank = jnp.sum(better, axis=1).astype(jnp.int32)   # stable desc rank
         sel = cand & (rank < jnp.minimum(budget, SLOTS - 1))
         num_sel = sel.sum().astype(jnp.int32)
 

@@ -365,9 +365,16 @@ def hist_routed(bins, g, h, c, leaf_id, tables, na_bin, num_slots, num_bins,
         return hist_routed_scatter(bins, g, h, c, leaf_id, tables, na_bin,
                                    num_slots, num_bins)
     if impl == "pallas":
-        from .pallas_hist import hist_pallas
+        from .pallas_hist import hist_pallas, route_level_pallas
         bt = bins_T if bins_T is not None else bins.T
-        slot, lid2 = route_level(bins, leaf_id, tables, na_bin, num_slots)
+        if bins.shape[1] <= 512:
+            slot, lid2 = route_level_pallas(bt, leaf_id, tables, na_bin,
+                                            num_slots, tables.feat.shape[0])
+        else:
+            # wide data: the route kernel's [F, chunk] block would exhaust
+            # VMEM; fall back to the XLA gather route (EFB bundling keeps
+            # training-width under this cap for sparse-wide datasets)
+            slot, lid2 = route_level(bins, leaf_id, tables, na_bin, num_slots)
         return hist_pallas(bt, g, h, c, slot, num_slots, num_bins), lid2
     return hist_routed_onehot(bins, g, h, c, leaf_id, tables, na_bin,
                               num_slots, num_bins)

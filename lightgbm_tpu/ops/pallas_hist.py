@@ -149,3 +149,137 @@ def hist_leaf_pallas(bins_T, g, h, c, num_bins: int,
     slot = jnp.zeros(bins_T.shape[1], jnp.int32)
     return hist_pallas(bins_T, g, h, c, slot, 1, num_bins,
                        interpret=interpret)[0]
+
+
+# ---------------------------------------------------------------------------
+# routing + small-table gathers
+#
+# A plain XLA gather of an [N] index vector from a small [L] table costs ~7ms
+# per million rows on v5e (no hardware gather; XLA lowers to per-element
+# dynamic-slice). One depthwise level needs ~7 such lookups -> ~50ms/level,
+# which dominated whole-tree time in rounds 1-2. Both kernels below express
+# the lookup as a one-hot [L, C] mask contraction — pure VPU/MXU work.
+# ---------------------------------------------------------------------------
+
+def _route_kernel(bins_ref, lid_ref, tabs_ref, nab_ref, slot_out, lid_out, *,
+                  f: int, l: int, s: int, chunk: int):
+    """Route one row-chunk through its leaf's split.
+
+    bins_ref: [F, C] uint8; lid_ref: [C] i32; tabs_ref: [8, L] f32 rows =
+    (feat, thr, dleft, new_leaf, slot_left, slot_right, _, _); nab_ref: [F, 1]
+    f32 missing-bin ids. Outputs: slot [C] i32, new leaf id [C] i32.
+    """
+    lid = lid_ref[:].reshape(1, chunk)
+    iota_l = jax.lax.broadcasted_iota(jnp.int32, (l, chunk), 0)
+    oh = (lid == iota_l).astype(jnp.float32)                     # [L, C]
+    # HIGHEST precision: the default MXU pass truncates the f32 tables operand
+    # to bf16, mis-decoding integer values > 256 (feature ids on wide data,
+    # leaf ids at num_leaves > 257) -> silent mis-routing
+    tv = jax.lax.dot_general(
+        tabs_ref[:], oh, dimension_numbers=(((1,), (0,)), ((), ())),
+        preferred_element_type=jnp.float32,
+        precision=jax.lax.Precision.HIGHEST)                     # [8, C] exact
+    feat, thr, dleft = tv[0:1], tv[1:2], tv[2:3]
+    new_leaf, slot_l, slot_r = tv[3:4], tv[4:5], tv[5:6]
+
+    # Mosaic has no direct uint8 -> f32 cast; hop through int32
+    bins_f = bins_ref[:].astype(jnp.int32).astype(jnp.float32)   # [F, C]
+    iota_f = jax.lax.broadcasted_iota(jnp.int32, (f, chunk), 0) \
+        .astype(jnp.float32)
+    fm = iota_f == feat                                          # [F, C]
+    colv = jnp.sum(jnp.where(fm, bins_f, 0.0), axis=0, keepdims=True)
+    nav = jnp.sum(jnp.where(fm, nab_ref[:].astype(jnp.float32), 0.0),
+                  axis=0, keepdims=True)
+    # all-f32 mask arithmetic: a bool-valued jnp.where lowers to an i1 select
+    # Mosaic cannot truncate to ("Unsupported target bitwidth for truncation")
+    has = jnp.where(feat >= 0, 1.0, 0.0)
+    is_na = jnp.where(colv == nav, 1.0, 0.0)
+    gr_na = jnp.where(dleft == 0, 1.0, 0.0)
+    gr_num = jnp.where(colv > thr, 1.0, 0.0)
+    go_right = is_na * gr_na + (1.0 - is_na) * gr_num
+    lid2 = jnp.where(has * go_right > 0, new_leaf, lid)
+    slot = has * (go_right * slot_r + (1.0 - go_right) * slot_l) \
+        + (1.0 - has) * float(s)
+    slot_out[:] = slot.astype(jnp.int32).reshape(chunk)
+    lid_out[:] = lid2.astype(jnp.int32).reshape(chunk)
+
+
+def route_level_pallas(bins_T, leaf_id, tables, na_bin, num_slots: int,
+                       num_leaves: int, chunk: int = _CHUNK,
+                       interpret: bool = False) -> Tuple[jnp.ndarray, jnp.ndarray]:
+    """Pallas DataPartition::Split analog. Returns (slot [N] i32, lid2 [N] i32)."""
+    f, n = bins_T.shape
+    l, s = num_leaves, num_slots
+    tabs = jnp.stack([
+        tables.feat.astype(jnp.float32), tables.thr.astype(jnp.float32),
+        tables.dleft.astype(jnp.float32), tables.new_leaf.astype(jnp.float32),
+        tables.slot_left.astype(jnp.float32),
+        tables.slot_right.astype(jnp.float32),
+        jnp.zeros(l, jnp.float32), jnp.zeros(l, jnp.float32)])    # [8, L]
+    nab = na_bin.astype(jnp.float32).reshape(f, 1)
+
+    bins_Tp = _pad_rows(bins_T, chunk)
+    lid_p = _pad_rows(leaf_id, chunk)
+    n_chunks = bins_Tp.shape[1] // chunk
+
+    kern = functools.partial(_route_kernel, f=f, l=l, s=s, chunk=chunk)
+    slot, lid2 = pl.pallas_call(
+        kern,
+        grid=(n_chunks,),
+        in_specs=[
+            pl.BlockSpec((f, chunk), lambda i: (0, i), memory_space=pltpu.VMEM),
+            pl.BlockSpec((chunk,), lambda i: (i,), memory_space=pltpu.VMEM),
+            pl.BlockSpec((8, l), lambda i: (0, 0), memory_space=pltpu.VMEM),
+            pl.BlockSpec((f, 1), lambda i: (0, 0), memory_space=pltpu.VMEM),
+        ],
+        out_specs=(
+            pl.BlockSpec((chunk,), lambda i: (i,), memory_space=pltpu.VMEM),
+            pl.BlockSpec((chunk,), lambda i: (i,), memory_space=pltpu.VMEM),
+        ),
+        out_shape=(
+            jax.ShapeDtypeStruct((bins_Tp.shape[1],), jnp.int32),
+            jax.ShapeDtypeStruct((bins_Tp.shape[1],), jnp.int32),
+        ),
+        interpret=interpret,
+    )(bins_Tp, lid_p, tabs, nab)
+    return slot[:n], lid2[:n]
+
+
+def _take_kernel(tab_ref, idx_ref, out_ref, *, l: int, chunk: int):
+    idx = idx_ref[:].reshape(1, chunk)
+    iota_l = jax.lax.broadcasted_iota(jnp.int32, (l, chunk), 0)
+    oh = (idx == iota_l).astype(jnp.float32)                     # [L, C]
+    # HIGHEST precision: default MXU bf16 truncation would round every leaf
+    # value to ~8 mantissa bits and bias all score updates
+    out = jax.lax.dot_general(
+        tab_ref[:].reshape(1, l), oh,
+        dimension_numbers=(((1,), (0,)), ((), ())),
+        preferred_element_type=jnp.float32,
+        precision=jax.lax.Precision.HIGHEST)                     # [1, C]
+    out_ref[:] = out.reshape(chunk)
+
+
+def take_small_pallas(table: jnp.ndarray, idx: jnp.ndarray,
+                      chunk: int = 8192, interpret: bool = False) -> jnp.ndarray:
+    """table[idx] for a small f32 table (out-of-range idx -> 0.0).
+
+    The MXU one-hot contraction replaces XLA's per-element gather (~7ms per
+    1M rows); measured sub-ms at 1M rows."""
+    l = table.shape[0]
+    n = idx.shape[0]
+    idx_p = _pad_rows(idx, chunk, value=l)
+    n_chunks = idx_p.shape[0] // chunk
+    kern = functools.partial(_take_kernel, l=l, chunk=chunk)
+    out = pl.pallas_call(
+        kern,
+        grid=(n_chunks,),
+        in_specs=[
+            pl.BlockSpec((l,), lambda i: (0,), memory_space=pltpu.VMEM),
+            pl.BlockSpec((chunk,), lambda i: (i,), memory_space=pltpu.VMEM),
+        ],
+        out_specs=pl.BlockSpec((chunk,), lambda i: (i,),
+                               memory_space=pltpu.VMEM),
+        out_shape=jax.ShapeDtypeStruct((idx_p.shape[0],), jnp.float32),
+        interpret=interpret,
+    )(table.astype(jnp.float32), idx_p)
+    return out[:n]

@@ -228,3 +228,38 @@ def test_hist_pallas_feature_grouping():
                                  jnp.asarray(h), jnp.asarray(c),
                                  jnp.asarray(slot), 1, b, interpret=True))[0]
     np.testing.assert_allclose(out, ref, rtol=1e-4, atol=1e-3)
+
+
+def test_route_level_pallas_matches_xla():
+    from lightgbm_tpu.ops.pallas_hist import route_level_pallas
+    rng = np.random.RandomState(9)
+    n, f, b, L, S = 4000, 5, 16, 8, 4
+    bins = rng.randint(0, b, size=(n, f)).astype(np.uint8)
+    leaf_id = rng.randint(0, L, size=n).astype(np.int32)
+    na_bin = np.array([3, 256, 256, 7, 256], dtype=np.int32)
+    tables = H.RouteTables(
+        feat=jnp.asarray(np.array([0, -1, 2, 4, 1, -1, 3, 0], np.int32)),
+        thr=jnp.asarray(rng.randint(0, b, size=L).astype(np.int32)),
+        dleft=jnp.asarray(rng.randint(0, 2, size=L).astype(np.int32)),
+        new_leaf=jnp.asarray((np.arange(L) + L).astype(np.int32)),
+        slot_left=jnp.asarray(rng.randint(0, S + 1, size=L).astype(np.int32)),
+        slot_right=jnp.asarray(rng.randint(0, S + 1, size=L).astype(np.int32)))
+    ref_slot, ref_lid = H.route_level(jnp.asarray(bins), jnp.asarray(leaf_id),
+                                      tables, jnp.asarray(na_bin), S)
+    out_slot, out_lid = route_level_pallas(
+        jnp.asarray(bins.T.copy()), jnp.asarray(leaf_id), tables,
+        jnp.asarray(na_bin), S, L, interpret=True)
+    np.testing.assert_array_equal(np.asarray(ref_lid), np.asarray(out_lid))
+    # sentinel slots (>= S) may differ in exact value; compare clamped
+    np.testing.assert_array_equal(np.minimum(np.asarray(ref_slot), S),
+                                  np.minimum(np.asarray(out_slot), S))
+
+
+def test_take_small_pallas():
+    from lightgbm_tpu.ops.pallas_hist import take_small_pallas
+    rng = np.random.RandomState(10)
+    table = rng.randn(255).astype(np.float32)
+    idx = rng.randint(0, 255, size=10000).astype(np.int32)
+    out = np.asarray(take_small_pallas(jnp.asarray(table), jnp.asarray(idx),
+                                       interpret=True))
+    np.testing.assert_allclose(out, table[idx], rtol=1e-6)
