@@ -363,44 +363,35 @@ class Booster:
         if not trees:
             base = np.zeros((n, k) if k > 1 else n)
             return base
-        # categorical splits compare count-ordered bins, not raw values: route
-        # through bin space for exact train/predict consistency
-        if (self.train_set is not None and not pred_leaf and not pred_contrib
-                and any(m.bin_type == 1 for m in self.train_set.mappers)):
-            raw = self._predict_binned(x, trees, k)
-            if raw_score:
-                return raw
-            obj = self._objective_for_predict()
-            return np.asarray(obj.convert_output(jnp.asarray(raw))) if obj else raw
-        if pred_leaf:
-            stack = stack_trees(trees, x.shape[1], 256)
-            mt = self._per_feature_missing(x.shape[1], trees)
-            xd = jnp.asarray(x, dtype=jnp.float32)
-            stack_dev = {kk: jnp.asarray(v) for kk, v in stack.items()}
-            max_steps = max(int(stack["num_leaves"].max()) - 1, 1)
-            out = P.predict_leaf_ensemble(stack_dev, xd, jnp.asarray(mt), max_steps)
-            return np.asarray(out)
         if pred_contrib:
             return self._predict_contrib(x, trees, k)
-        stack = stack_trees(trees, x.shape[1], 256)
-        mt = self._per_feature_missing(x.shape[1], trees)
-        xd = jnp.asarray(x, dtype=jnp.float32)
-        max_steps = max(int(stack["num_leaves"].max()) - 1, 1)
+        # unified exact routing: pseudo-bin the input on the host in f64 and
+        # walk the trees on device with integer compares + categorical bitsets
+        # (io/pseudo_bins.py) — identical for in-session and loaded models
+        from .io.pseudo_bins import PseudoRouter
+        router = getattr(self, "_pseudo_router", None)
+        if router is None or router.n_trees != len(trees):
+            router = PseudoRouter(trees, x.shape[1])
+            router.n_trees = len(trees)
+            self._pseudo_router = router
+        pbins = jnp.asarray(router.bin_matrix(x))
+        na_dev = jnp.asarray(router.na_id)
+        stack_dev = {kk: jnp.asarray(v) for kk, v in router.stack.items()}
+        if pred_leaf:
+            out = P.leaf_bins_ensemble(stack_dev, pbins, na_dev,
+                                       router.max_steps)
+            return np.asarray(out)
         if k == 1:
-            stack_dev = {kk: jnp.asarray(v) for kk, v in stack.items()}
-            raw = np.asarray(P.predict_raw_ensemble(stack_dev, xd, jnp.asarray(mt),
-                                                    max_steps), dtype=np.float64)
+            raw = np.asarray(P.predict_bins_ensemble(
+                stack_dev, pbins, na_dev, router.max_steps), dtype=np.float64)
             if self._avg_output():
-                raw = raw / (len(trees))
+                raw = raw / len(trees)
         else:
             raw = np.zeros((n, k))
             for cls in range(k):
-                cls_trees = trees[cls::k]
-                stack_c = stack_trees(cls_trees, x.shape[1], 256)
-                stack_dev = {kk: jnp.asarray(v) for kk, v in stack_c.items()}
-                ms = max(int(stack_c["num_leaves"].max()) - 1, 1)
-                raw[:, cls] = np.asarray(
-                    P.predict_raw_ensemble(stack_dev, xd, jnp.asarray(mt), ms))
+                sub = {kk: v[cls::k] for kk, v in stack_dev.items()}
+                raw[:, cls] = np.asarray(P.predict_bins_ensemble(
+                    sub, pbins, na_dev, router.max_steps))
             if self._avg_output():
                 raw = raw / (len(trees) // k)
         if raw_score:

@@ -147,9 +147,16 @@ def model_to_cpp(booster, trees: List[Tree]) -> str:
     parts = [
         "#include <cmath>",
         "#include <cstdint>",
+        "#include <initializer_list>",
         "static inline bool IsLeft(double v, double thr, bool default_left) {",
         "  if (std::isnan(v)) return default_left;",
         "  return v <= thr;",
+        "}",
+        "static inline bool IsCatLeft(double v, std::initializer_list<int> s) {",
+        "  if (std::isnan(v) || v < 0) return false;",
+        "  int iv = static_cast<int>(v);",
+        "  for (int c : s) if (c == iv) return true;",
+        "  return false;",
         "}",
         "",
     ]

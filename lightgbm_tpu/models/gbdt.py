@@ -74,6 +74,9 @@ class GBDT:
         # 2.2x slower at B=63 vs B=64 on v5e)
         maxb = train_set.max_num_bins
         B = 64 if maxb <= 64 else (128 if maxb <= 128 else 256)
+        from ..binning import BIN_CATEGORICAL
+        cat_feats = tuple(i for i, m in enumerate(train_set.mappers)
+                          if m.bin_type == BIN_CATEGORICAL)
         self.gp = GrowParams(
             num_leaves=config.num_leaves,
             max_depth=config.max_depth,
@@ -83,7 +86,12 @@ class GBDT:
                 min_gain_to_split=config.min_gain_to_split,
                 min_data_in_leaf=config.min_data_in_leaf,
                 min_sum_hessian_in_leaf=config.min_sum_hessian_in_leaf,
-                max_delta_step=config.max_delta_step),
+                max_delta_step=config.max_delta_step,
+                cat_features=cat_feats,
+                cat_l2=config.cat_l2, cat_smooth=config.cat_smooth,
+                max_cat_threshold=config.max_cat_threshold,
+                max_cat_to_onehot=config.max_cat_to_onehot,
+                min_data_per_group=config.min_data_per_group),
             hist_impl=config.histogram_impl,
         )
         self._bag_rng = np.random.RandomState(config.bagging_seed)

@@ -31,7 +31,10 @@ class Tree:
                  internal_weight: np.ndarray, internal_count: np.ndarray,
                  threshold_real: Optional[np.ndarray] = None,
                  missing_type: Optional[np.ndarray] = None,
-                 shrinkage: float = 1.0):
+                 shrinkage: float = 1.0,
+                 is_cat_node: Optional[np.ndarray] = None,
+                 cat_sets: Optional[List[np.ndarray]] = None,
+                 cat_mask_bins: Optional[np.ndarray] = None):
         self.num_leaves = int(num_leaves)
         n_int = max(self.num_leaves - 1, 0)
         self.split_feature = np.asarray(split_feature[:n_int], dtype=np.int32)
@@ -53,6 +56,21 @@ class Tree:
                              if missing_type is not None
                              else np.zeros(n_int, dtype=np.int32))
         self.shrinkage = shrinkage
+        # categorical subset nodes (reference: tree.h:279 CategoricalDecision):
+        # cat_sets[i] = raw category values routed LEFT at node i (empty for
+        # numerical nodes); cat_mask_bins = [n_int, B] bin-space membership
+        # (device-aligned, kept for bin-space routing of training data)
+        self.is_cat_node = (np.asarray(is_cat_node[:n_int], dtype=bool)
+                            if is_cat_node is not None
+                            else np.zeros(n_int, dtype=bool))
+        self.cat_sets = (list(cat_sets) if cat_sets is not None
+                         else [np.empty(0, dtype=np.int64)] * n_int)
+        self.cat_mask_bins = (np.asarray(cat_mask_bins[:n_int], dtype=bool)
+                              if cat_mask_bins is not None else None)
+
+    @property
+    def num_cat(self) -> int:
+        return int(self.is_cat_node.sum())
 
     @staticmethod
     def from_device(arrays, mappers: List[BinMapper],
@@ -61,12 +79,26 @@ class Tree:
         nl = int(arrays.num_leaves)
         sf = np.asarray(arrays.split_feature)
         tb = np.asarray(arrays.threshold_bin)
+        is_cat = np.asarray(arrays.is_cat)
+        cat_mask = np.asarray(arrays.cat_mask)
         n_int = max(nl - 1, 0)
         thr_real = np.zeros(n_int)
         mtypes = np.zeros(n_int, dtype=np.int32)
+        cat_sets: List[np.ndarray] = []
         for i in range(n_int):
             m = mappers[sf[i]]
-            thr_real[i] = m.bin_to_value(int(tb[i]))
+            if is_cat[i]:
+                # member bins -> raw categories (bin b holds cat_values[b-1];
+                # bin 0 = other/missing, excluded from subsets by construction)
+                member_bins = np.nonzero(cat_mask[i])[0]
+                member_bins = member_bins[(member_bins >= 1)
+                                          & (member_bins <= len(m.cat_values))]
+                cat_sets.append(np.sort(m.cat_values[member_bins - 1])
+                                .astype(np.int64))
+                thr_real[i] = 0.0  # rewritten to the cat index at serialization
+            else:
+                cat_sets.append(np.empty(0, dtype=np.int64))
+                thr_real[i] = m.bin_to_value(int(tb[i]))
             mtypes[i] = m.missing_type
         if feature_map is not None:
             sf_orig = feature_map[sf[:n_int]] if n_int else sf[:n_int]
@@ -86,6 +118,8 @@ class Tree:
             internal_weight=np.asarray(arrays.internal_weight),
             internal_count=np.asarray(arrays.internal_count),
             threshold_real=thr_real, missing_type=mtypes,
+            is_cat_node=is_cat, cat_sets=cat_sets,
+            cat_mask_bins=cat_mask[:n_int] if n_int else None,
         )
 
     # ---- mutation (reference: Tree::Shrinkage tree.h:154, AddBias tree.h:172) ----
@@ -141,12 +175,29 @@ class Tree:
                                   np.where(mt == MISSING_ZERO,
                                            (np.abs(v0) < 1e-35) | isnan, False))
             go_left = np.where(is_missing, self.default_left[nd], v0 <= thr)
+            if self.is_cat_node.any():
+                cat_here = self.is_cat_node[nd]
+                if cat_here.any():
+                    gl_cat = np.zeros(len(nd), dtype=bool)
+                    for j in np.nonzero(cat_here)[0]:
+                        vv = v[j]
+                        gl_cat[j] = (not np.isnan(vv) and vv >= 0 and
+                                     int(vv) in self._cat_lookup(int(nd[j])))
+                    go_left = np.where(cat_here, gl_cat, go_left)
             nxt = np.where(go_left, self.left_child[nd], self.right_child[nd])
             leaf_hit = nxt < 0
             out[idx[leaf_hit]] = ~nxt[leaf_hit]
             node[idx[~leaf_hit]] = nxt[~leaf_hit]
             active[idx[leaf_hit]] = False
         return out
+
+    def _cat_lookup(self, node: int):
+        key = getattr(self, "_cat_lut", None)
+        if key is None:
+            key = self._cat_lut = {
+                i: frozenset(int(v) for v in self.cat_sets[i])
+                for i in np.nonzero(self.is_cat_node)[0]}
+        return key.get(node, frozenset())
 
     # ---- serialization (reference: gbdt_model_text.cpp:271 per-tree blocks) ----
     def to_string(self, tree_idx: int) -> str:
@@ -155,18 +206,38 @@ class Tree:
 
         n_int = self.num_leaves - 1
         decision_type = np.zeros(max(n_int, 0), dtype=np.int32)
+        thr_out = self.threshold_real.copy()
+        # categorical nodes: decision_type bit0, threshold = cat index, and
+        # bitsets over raw category values (reference: Tree::ToString writes
+        # cat_boundaries_/cat_threshold_, gbdt_model_text.cpp + tree.cpp;
+        # bitsets via Common::ConstructBitset: bit v -> word v//32)
+        cat_boundaries = [0]
+        cat_words: List[int] = []
+        cat_idx = 0
         for i in range(n_int):
             dt = 0  # bit0: categorical; bit1: default_left; bits2-3: missing type
-            if self.default_left[i]:
-                dt |= 2
+            if self.is_cat_node[i]:
+                dt |= 1
+                thr_out[i] = cat_idx
+                vals = self.cat_sets[i]
+                n_words = (int(vals.max()) // 32 + 1) if len(vals) else 1
+                words = [0] * n_words
+                for v in vals:
+                    words[int(v) // 32] |= 1 << (int(v) % 32)
+                cat_words.extend(words)
+                cat_boundaries.append(cat_boundaries[-1] + n_words)
+                cat_idx += 1
+            else:
+                if self.default_left[i]:
+                    dt |= 2
             dt |= _MISSING_TYPE_MASK.get(int(self.missing_type[i]), 0)
             decision_type[i] = dt
         lines = [f"Tree={tree_idx}",
                  f"num_leaves={self.num_leaves}",
-                 "num_cat=0",
+                 f"num_cat={cat_idx}",
                  f"split_feature={arr(self.split_feature, '%d')}",
                  f"split_gain={arr(self.split_gain)}",
-                 f"threshold={arr(self.threshold_real, '%.17g')}",
+                 f"threshold={arr(thr_out, '%.17g')}",
                  f"decision_type={arr(decision_type, '%d')}",
                  f"left_child={arr(self.left_child, '%d')}",
                  f"right_child={arr(self.right_child, '%d')}",
@@ -178,6 +249,13 @@ class Tree:
                  f"internal_count={arr(self.internal_count, '%d')}",
                  f"shrinkage={self.shrinkage:g}",
                  ""]
+        if cat_idx > 0:
+            ins = [f"cat_boundaries={arr(cat_boundaries, '%d')}",
+                   f"cat_threshold={arr(cat_words, '%d')}"]
+            # insert after decision_type line (reference field order)
+            pos = next(i for i, ln in enumerate(lines)
+                       if ln.startswith("left_child="))
+            lines[pos:pos] = ins
         return "\n".join(lines)
 
     @staticmethod
@@ -200,6 +278,23 @@ class Tree:
         default_left = (dt & 2) > 0
         mt = np.where((dt & 12) == 8, MISSING_NAN,
                       np.where((dt & 12) == 4, MISSING_ZERO, MISSING_NONE))
+        is_cat = (dt & 1) > 0
+        thr = arr("threshold", np.float64, n_int)
+        cat_sets: List[np.ndarray] = [np.empty(0, dtype=np.int64)] * n_int
+        num_cat = int(kv.get("num_cat", 0))
+        if num_cat > 0:
+            bounds = arr("cat_boundaries", np.int64, num_cat + 1)
+            words = arr("cat_threshold", np.uint64, int(bounds[-1])).astype(np.uint32)
+            for i in np.nonzero(is_cat)[0]:
+                ci = int(thr[i])
+                vals = []
+                for w_i in range(int(bounds[ci]), int(bounds[ci + 1])):
+                    w = int(words[w_i])
+                    base = (w_i - int(bounds[ci])) * 32
+                    for bit in range(32):
+                        if w & (1 << bit):
+                            vals.append(base + bit)
+                cat_sets[i] = np.asarray(vals, dtype=np.int64)
         t = Tree(
             num_leaves=nl,
             split_feature=arr("split_feature", np.int32, n_int),
@@ -214,9 +309,10 @@ class Tree:
             internal_value=arr("internal_value", np.float64, n_int),
             internal_weight=arr("internal_weight", np.float64, n_int),
             internal_count=arr("internal_count", np.int64, n_int),
-            threshold_real=arr("threshold", np.float64, n_int),
+            threshold_real=thr,
             missing_type=mt,
             shrinkage=float(kv.get("shrinkage", 1.0)),
+            is_cat_node=is_cat, cat_sets=cat_sets,
         )
         return t
 
@@ -228,6 +324,22 @@ class Tree:
                         "leaf_value": float(self.leaf_value[leaf]),
                         "leaf_weight": float(self.leaf_weight[leaf]),
                         "leaf_count": int(self.leaf_count[leaf])}
+            if self.is_cat_node[ptr]:
+                thr_str = "||".join(str(int(v)) for v in self.cat_sets[ptr])
+                return {
+                    "split_index": int(ptr),
+                    "split_feature": int(self.split_feature[ptr]),
+                    "split_gain": float(self.split_gain[ptr]),
+                    "threshold": thr_str,
+                    "decision_type": "==",
+                    "default_left": False,
+                    "missing_type": ["None", "Zero", "NaN"][int(self.missing_type[ptr])],
+                    "internal_value": float(self.internal_value[ptr]),
+                    "internal_weight": float(self.internal_weight[ptr]),
+                    "internal_count": int(self.internal_count[ptr]),
+                    "left_child": node_json(int(self.left_child[ptr])),
+                    "right_child": node_json(int(self.right_child[ptr])),
+                }
             return {
                 "split_index": int(ptr),
                 "split_feature": int(self.split_feature[ptr]),
@@ -244,7 +356,7 @@ class Tree:
             }
         root = 0 if self.num_leaves > 1 else ~0
         return {"tree_index": tree_idx, "num_leaves": self.num_leaves,
-                "num_cat": 0, "shrinkage": self.shrinkage,
+                "num_cat": self.num_cat, "shrinkage": self.shrinkage,
                 "tree_structure": node_json(root)}
 
     def to_if_else(self, index: int) -> str:
@@ -253,6 +365,14 @@ class Tree:
             if ptr < 0:
                 return f"{indent}return {float(self.leaf_value[~ptr]):.17g};\n"
             f_ = int(self.split_feature[ptr])
+            if self.is_cat_node[ptr]:
+                vals = ", ".join(str(int(v)) for v in self.cat_sets[ptr])
+                s = f"{indent}if (IsCatLeft(arr[{f_}], {{{vals}}})) {{\n"
+                s += rec(int(self.left_child[ptr]), indent + "  ")
+                s += f"{indent}}} else {{\n"
+                s += rec(int(self.right_child[ptr]), indent + "  ")
+                s += f"{indent}}}\n"
+                return s
             thr = float(self.threshold_real[ptr])
             dl = "true" if self.default_left[ptr] else "false"
             s = f"{indent}if (IsLeft(arr[{f_}], {thr:.17g}, {dl})) {{\n"
@@ -282,6 +402,8 @@ def stack_trees(trees: List[Tree], num_features: int, max_num_bins: int,
         "leaf_value": np.zeros((t, max_l), dtype=np.float32),
         "num_leaves": np.zeros((t,), dtype=np.int32),
         "missing_type": np.zeros((t, max_i), dtype=np.int32),
+        "is_cat": np.zeros((t, max_i), dtype=bool),
+        "cat_mask": np.zeros((t, max_i, max_num_bins), dtype=bool),
     }
     for i, tr in enumerate(trees):
         n_int = max(tr.num_leaves - 1, 0)
@@ -294,4 +416,8 @@ def stack_trees(trees: List[Tree], num_features: int, max_num_bins: int,
         out["leaf_value"][i, : tr.num_leaves] = tr.leaf_value
         out["num_leaves"][i] = tr.num_leaves
         out["missing_type"][i, :n_int] = tr.missing_type
+        out["is_cat"][i, :n_int] = tr.is_cat_node
+        if tr.cat_mask_bins is not None and n_int:
+            bsz = min(tr.cat_mask_bins.shape[1], max_num_bins)
+            out["cat_mask"][i, :n_int, :bsz] = tr.cat_mask_bins[:, :bsz]
     return out

@@ -68,6 +68,8 @@ class TreeArrays(NamedTuple):
     internal_weight: jnp.ndarray # [L-1] f32
     internal_count: jnp.ndarray  # [L-1] f32
     num_leaves: jnp.ndarray      # scalar i32
+    is_cat: jnp.ndarray          # [L-1] bool: categorical subset split
+    cat_mask: jnp.ndarray        # [L-1, B] bool: bins routed LEFT (cat nodes)
 
 
 class _GrowState(NamedTuple):
@@ -84,7 +86,7 @@ class _GrowState(NamedTuple):
     done: jnp.ndarray            # scalar bool
 
 
-def _empty_tree(L: int) -> TreeArrays:
+def _empty_tree(L: int, B: int = 256) -> TreeArrays:
     zi = jnp.zeros(max(L - 1, 1), dtype=jnp.int32)
     zf = jnp.zeros(max(L - 1, 1), dtype=jnp.float32)
     return TreeArrays(
@@ -94,6 +96,8 @@ def _empty_tree(L: int) -> TreeArrays:
         leaf_count=jnp.zeros(L, jnp.float32),
         internal_value=zf, internal_weight=zf, internal_count=zf,
         num_leaves=jnp.int32(1),
+        is_cat=jnp.zeros(max(L - 1, 1), dtype=bool),
+        cat_mask=jnp.zeros((max(L - 1, 1), B), dtype=bool),
     )
 
 
@@ -141,7 +145,9 @@ def grow_tree(bins: jnp.ndarray, g: jnp.ndarray, h: jnp.ndarray, c: jnp.ndarray,
         gain=tile(best0.gain, NEG_INF), feature=tile(best0.feature, 0),
         bin=tile(best0.bin, 0), default_left=tile(best0.default_left, False),
         left_g=tile(best0.left_g, 0.0), left_h=tile(best0.left_h, 0.0),
-        left_cnt=tile(best0.left_cnt, 0.0))
+        left_cnt=tile(best0.left_cnt, 0.0),
+        is_cat=tile(best0.is_cat, False),
+        cat_member=jnp.zeros((L, B), dtype=bool).at[0].set(best0.cat_member))
 
     hist = jnp.zeros((L, 3, f, B), dtype=jnp.float32).at[0].set(hist0)
     state = _GrowState(
@@ -152,7 +158,7 @@ def grow_tree(bins: jnp.ndarray, g: jnp.ndarray, h: jnp.ndarray, c: jnp.ndarray,
         leaf_depth=jnp.zeros(L, jnp.int32),
         parent_node=jnp.full(L, -1, jnp.int32),
         parent_right=jnp.zeros(L, dtype=bool),
-        best=best, tree=_empty_tree(L), done=jnp.bool_(L < 2),
+        best=best, tree=_empty_tree(L, B), done=jnp.bool_(L < 2),
     )
 
     def step(st: _GrowState, t):
@@ -170,6 +176,12 @@ def grow_tree(bins: jnp.ndarray, g: jnp.ndarray, h: jnp.ndarray, c: jnp.ndarray,
             col = bins[:, feat].astype(jnp.int32)
             is_na = col == na_bin[feat]
             go_right = jnp.where(is_na, ~dleft, col > thr)
+            if sp.cat_features:
+                from .gather import take_small
+                iscat = st.best.is_cat[l]
+                memrow = st.best.cat_member[l].astype(jnp.float32)
+                mem = take_small(memrow, col) > 0.5
+                go_right = jnp.where(iscat, ~mem, go_right)
             in_leaf = st.leaf_id == l
             leaf_id2 = jnp.where(in_leaf & go_right, new_leaf, st.leaf_id)
 
@@ -218,6 +230,8 @@ def grow_tree(bins: jnp.ndarray, g: jnp.ndarray, h: jnp.ndarray, c: jnp.ndarray,
                 internal_weight=tr.internal_weight.at[t].set(ph),
                 internal_count=tr.internal_count.at[t].set(pc),
                 num_leaves=tr.num_leaves + 1,
+                is_cat=tr.is_cat.at[t].set(st.best.is_cat[l]),
+                cat_mask=tr.cat_mask.at[t].set(st.best.cat_member[l]),
             )
 
             # ---- best splits for the two children (batched, not vmapped) ----

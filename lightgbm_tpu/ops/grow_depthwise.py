@@ -94,7 +94,7 @@ def grow_tree_depthwise(bins: jnp.ndarray, g: jnp.ndarray, h: jnp.ndarray,
         active=jnp.zeros(L, bool).at[0].set(True),
         parent_node=jnp.full(L, -1, jnp.int32),
         parent_right=jnp.zeros(L, bool),
-        tree=_empty_tree(L),
+        tree=_empty_tree(L, B),
     )
     # root leaf value (kept if nothing splits)
     root_w = leaf_output(g0, h0, sp)
@@ -166,6 +166,8 @@ def grow_tree_depthwise(bins: jnp.ndarray, g: jnp.ndarray, h: jnp.ndarray,
             internal_count=_scatter_set(tr.internal_count, node_id,
                                         st.leaf_c, sel),
             num_leaves=tr.num_leaves + num_sel,
+            is_cat=_scatter_set(tr.is_cat, node_id, res.is_cat, sel),
+            cat_mask=_scatter_set(tr.cat_mask, node_id, res.cat_member, sel),
         )
 
         # ---- fused route + smaller-child histogram pass ----
@@ -178,6 +180,10 @@ def grow_tree_depthwise(bins: jnp.ndarray, g: jnp.ndarray, h: jnp.ndarray,
             # slot only for the smaller child; larger sibling = parent - smaller
             slot_left=jnp.where(sel & small_is_left, idx_in_lvl, SLOTS),
             slot_right=jnp.where(sel & ~small_is_left, idx_in_lvl, SLOTS),
+            is_cat=(res.is_cat & sel).astype(jnp.int32) if sp.cat_features
+            else None,
+            member=(res.cat_member & sel[:, None]).astype(jnp.float32)
+            if sp.cat_features else None,
         )
         hist_small, leaf_id2 = H.hist_routed(
             bins, g, h, c, st.leaf_id, tables, na_bin, SLOTS, B, gp.hist_impl,

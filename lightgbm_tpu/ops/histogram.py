@@ -102,6 +102,10 @@ class RouteTables(NamedTuple):
     new_leaf: jnp.ndarray    # leaf id of the right child
     slot_left: jnp.ndarray
     slot_right: jnp.ndarray
+    # categorical subset decisions (reference: CategoricalDecision, tree.h:279):
+    # is_cat [L] i32 flags, member [L, B] f32 0/1 bin membership (member -> LEFT)
+    is_cat: Optional[jnp.ndarray] = None
+    member: Optional[jnp.ndarray] = None
 
 
 # ---------------------------------------------------------------------------
@@ -205,6 +209,11 @@ def route_level(bins, leaf_id, tables: RouteTables, na_bin, num_slots: int
     is_na = colv == nav
     go_right = jnp.where(is_na, jnp.take(tables.dleft, leaf_id) == 0,
                          colv > jnp.take(tables.thr, leaf_id))
+    if tables.is_cat is not None:
+        bm = tables.member.shape[1]
+        mem = jnp.take(tables.member.reshape(-1), leaf_id * bm + colv) > 0.5
+        iscat = jnp.take(tables.is_cat, leaf_id) > 0
+        go_right = jnp.where(iscat, ~mem, go_right)
     lid2 = jnp.where(has & go_right, jnp.take(tables.new_leaf, leaf_id), leaf_id)
     slot = jnp.where(has,
                      jnp.where(go_right, jnp.take(tables.slot_right, leaf_id),
@@ -241,6 +250,8 @@ def hist_routed_onehot(bins, g, h, c, leaf_id, tables: RouteTables, na_bin,
     newl_r = jnp.take(tables.new_leaf, lid).reshape(n_tiles, tile)
     sl_r = jnp.take(tables.slot_left, lid).reshape(n_tiles, tile)
     sr_r = jnp.take(tables.slot_right, lid).reshape(n_tiles, tile)
+    iscat_r = (jnp.take(tables.is_cat, lid).reshape(n_tiles, tile)
+               if tables.is_cat is not None else jnp.zeros_like(thr_r))
 
     bins_t = bins_p.reshape(n_tiles, tile, f)
     g_t = g.reshape(n_tiles, tile)
@@ -250,7 +261,7 @@ def hist_routed_onehot(bins, g, h, c, leaf_id, tables: RouteTables, na_bin,
     iota_f = jnp.arange(f, dtype=jnp.int32)
 
     def step(carry, xs):
-        bt, gt, ht, ct, lt, ft, tt, dt, nt, slt, srt = xs
+        bt, gt, ht, ct, lt, ft, tt, dt, nt, slt, srt, ict = xs
         # ---- route (vectorized NumericalDecision, tree.h:240) ----
         fm = ft[:, None] == iota_f[None, :]                        # [T, F] in-fusion
         colv = jnp.sum(jnp.where(fm, bt.astype(jnp.int32), 0), axis=1)
@@ -258,6 +269,10 @@ def hist_routed_onehot(bins, g, h, c, leaf_id, tables: RouteTables, na_bin,
         has = ft >= 0
         is_na = colv == nav
         go_right = jnp.where(is_na, dt == 0, colv > tt)
+        if tables.is_cat is not None:
+            bm = tables.member.shape[1]
+            mem = jnp.take(tables.member.reshape(-1), lt * bm + colv) > 0.5
+            go_right = jnp.where(ict > 0, ~mem, go_right)
         lt2 = jnp.where(has & go_right, nt, lt)
         slot = jnp.where(has, jnp.where(go_right, srt, slt), s)    # s = sentinel
 
@@ -273,7 +288,8 @@ def hist_routed_onehot(bins, g, h, c, leaf_id, tables: RouteTables, na_bin,
     init = jnp.zeros((f * b, s * 6), dtype=acc_dtype)
     hist, lid2 = jax.lax.scan(
         step, init,
-        (bins_t, g_t, h_t, c_t, lid_t, feat_r, thr_r, dleft_r, newl_r, sl_r, sr_r))
+        (bins_t, g_t, h_t, c_t, lid_t, feat_r, thr_r, dleft_r, newl_r, sl_r,
+         sr_r, iscat_r))
     return _hi_lo_combine(hist, f, b, s), lid2.reshape(-1)[:n]
 
 

@@ -161,14 +161,19 @@ def hist_leaf_pallas(bins_T, g, h, c, num_bins: int,
 # the lookup as a one-hot [L, C] mask contraction — pure VPU/MXU work.
 # ---------------------------------------------------------------------------
 
-def _route_kernel(bins_ref, lid_ref, tabs_ref, nab_ref, slot_out, lid_out, *,
-                  f: int, l: int, s: int, chunk: int):
+def _route_kernel(*refs, f: int, l: int, s: int, chunk: int, b: int,
+                  has_cat: bool):
     """Route one row-chunk through its leaf's split.
 
-    bins_ref: [F, C] uint8; lid_ref: [C] i32; tabs_ref: [8, L] f32 rows =
-    (feat, thr, dleft, new_leaf, slot_left, slot_right, _, _); nab_ref: [F, 1]
-    f32 missing-bin ids. Outputs: slot [C] i32, new leaf id [C] i32.
+    refs: bins [F, C] uint8; lid [C] i32; tabs [8, L] f32 rows = (feat, thr,
+    dleft, new_leaf, slot_left, slot_right, is_cat, _); nab [F, 1] f32
+    missing-bin ids; [memT [B, L] f32 when has_cat]; outputs slot [C] i32,
+    new leaf id [C] i32.
     """
+    if has_cat:
+        bins_ref, lid_ref, tabs_ref, nab_ref, memT_ref, slot_out, lid_out = refs
+    else:
+        bins_ref, lid_ref, tabs_ref, nab_ref, slot_out, lid_out = refs
     lid = lid_ref[:].reshape(1, chunk)
     iota_l = jax.lax.broadcasted_iota(jnp.int32, (l, chunk), 0)
     oh = (lid == iota_l).astype(jnp.float32)                     # [L, C]
@@ -197,6 +202,18 @@ def _route_kernel(bins_ref, lid_ref, tabs_ref, nab_ref, slot_out, lid_out, *,
     gr_na = jnp.where(dleft == 0, 1.0, 0.0)
     gr_num = jnp.where(colv > thr, 1.0, 0.0)
     go_right = is_na * gr_na + (1.0 - is_na) * gr_num
+    if has_cat:
+        # categorical membership (CategoricalDecision, tree.h:279): decode the
+        # leaf's [B] bin-membership row, pick the row's bin -> member -> LEFT
+        mem_bc = jax.lax.dot_general(
+            memT_ref[:], oh, dimension_numbers=(((1,), (0,)), ((), ())),
+            preferred_element_type=jnp.float32)                  # [B, C] 0/1
+        iota_b = jax.lax.broadcasted_iota(jnp.int32, (b, chunk), 0) \
+            .astype(jnp.float32)
+        member = jnp.sum(jnp.where(iota_b == colv, mem_bc, 0.0),
+                         axis=0, keepdims=True)
+        iscat = tv[6:7]
+        go_right = iscat * (1.0 - member) + (1.0 - iscat) * go_right
     lid2 = jnp.where(has * go_right > 0, new_leaf, lid)
     slot = has * (go_right * slot_r + (1.0 - go_right) * slot_l) \
         + (1.0 - has) * float(s)
@@ -210,28 +227,40 @@ def route_level_pallas(bins_T, leaf_id, tables, na_bin, num_slots: int,
     """Pallas DataPartition::Split analog. Returns (slot [N] i32, lid2 [N] i32)."""
     f, n = bins_T.shape
     l, s = num_leaves, num_slots
+    has_cat = tables.is_cat is not None
+    iscat_row = (tables.is_cat.astype(jnp.float32) if has_cat
+                 else jnp.zeros(l, jnp.float32))
     tabs = jnp.stack([
         tables.feat.astype(jnp.float32), tables.thr.astype(jnp.float32),
         tables.dleft.astype(jnp.float32), tables.new_leaf.astype(jnp.float32),
         tables.slot_left.astype(jnp.float32),
         tables.slot_right.astype(jnp.float32),
-        jnp.zeros(l, jnp.float32), jnp.zeros(l, jnp.float32)])    # [8, L]
+        iscat_row, jnp.zeros(l, jnp.float32)])                    # [8, L]
     nab = na_bin.astype(jnp.float32).reshape(f, 1)
 
     bins_Tp = _pad_rows(bins_T, chunk)
     lid_p = _pad_rows(leaf_id, chunk)
     n_chunks = bins_Tp.shape[1] // chunk
 
-    kern = functools.partial(_route_kernel, f=f, l=l, s=s, chunk=chunk)
+    in_specs = [
+        pl.BlockSpec((f, chunk), lambda i: (0, i), memory_space=pltpu.VMEM),
+        pl.BlockSpec((chunk,), lambda i: (i,), memory_space=pltpu.VMEM),
+        pl.BlockSpec((8, l), lambda i: (0, 0), memory_space=pltpu.VMEM),
+        pl.BlockSpec((f, 1), lambda i: (0, 0), memory_space=pltpu.VMEM),
+    ]
+    args = [bins_Tp, lid_p, tabs, nab]
+    b_mem = tables.member.shape[1] if has_cat else 1
+    if has_cat:
+        in_specs.append(pl.BlockSpec((b_mem, l), lambda i: (0, 0),
+                                     memory_space=pltpu.VMEM))
+        args.append(tables.member.astype(jnp.float32).T)
+
+    kern = functools.partial(_route_kernel, f=f, l=l, s=s, chunk=chunk,
+                             b=b_mem, has_cat=has_cat)
     slot, lid2 = pl.pallas_call(
         kern,
         grid=(n_chunks,),
-        in_specs=[
-            pl.BlockSpec((f, chunk), lambda i: (0, i), memory_space=pltpu.VMEM),
-            pl.BlockSpec((chunk,), lambda i: (i,), memory_space=pltpu.VMEM),
-            pl.BlockSpec((8, l), lambda i: (0, 0), memory_space=pltpu.VMEM),
-            pl.BlockSpec((f, 1), lambda i: (0, 0), memory_space=pltpu.VMEM),
-        ],
+        in_specs=in_specs,
         out_specs=(
             pl.BlockSpec((chunk,), lambda i: (i,), memory_space=pltpu.VMEM),
             pl.BlockSpec((chunk,), lambda i: (i,), memory_space=pltpu.VMEM),
@@ -241,7 +270,7 @@ def route_level_pallas(bins_T, leaf_id, tables, na_bin, num_slots: int,
             jax.ShapeDtypeStruct((bins_Tp.shape[1],), jnp.int32),
         ),
         interpret=interpret,
-    )(bins_Tp, lid_p, tabs, nab)
+    )(*args)
     return slot[:n], lid2[:n]
 
 

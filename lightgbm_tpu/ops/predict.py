@@ -15,12 +15,18 @@ import jax.numpy as jnp
 
 
 def route_bins(split_feature, threshold_bin, default_left, left_child, right_child,
-               num_leaves, bins, na_bin, max_steps: int):
-    """Leaf index for each row of a *binned* matrix. bins: [N, F] uint8."""
+               num_leaves, bins, na_bin, max_steps: int,
+               is_cat=None, cat_mask=None):
+    """Leaf index for each row of a *binned* matrix. bins: [N, F] uint8/int32.
+
+    is_cat [n_nodes] bool + cat_mask [n_nodes, B] bool extend the walk with
+    categorical subset decisions (bin member -> LEFT; reference: tree.h:279)."""
     n = bins.shape[0]
     # pointer: >=0 internal node, <0 leaf (~leaf)
     start = jnp.where(num_leaves > 1, 0, -1)
     ptr = jnp.full((n,), start, dtype=jnp.int32)
+    mem_flat = (cat_mask.reshape(-1).astype(jnp.float32)
+                if cat_mask is not None else None)
 
     def body(_, ptr):
         node = jnp.maximum(ptr, 0)
@@ -30,6 +36,12 @@ def route_bins(split_feature, threshold_bin, default_left, left_child, right_chi
         col = col.astype(jnp.int32)
         is_na = col == na_bin[feat]
         go_left = jnp.where(is_na, default_left[node], col <= thr)
+        if is_cat is not None:
+            bm = cat_mask.shape[1]
+            mem = jnp.take(mem_flat, node * bm + jnp.clip(col, 0, bm - 1),
+                           mode="fill", fill_value=0.0) > 0.5
+            mem = mem & (col < bm)
+            go_left = jnp.where(is_cat[node], mem, go_left)
         nxt = jnp.where(go_left, left_child[node], right_child[node])
         return jnp.where(ptr >= 0, nxt, ptr)
 
@@ -77,16 +89,50 @@ def predict_bins_ensemble(tree_stack, bins, na_bin, max_steps: int):
     tree_stack: dict of arrays with leading tree axis [T, ...] (from
     models.tree.stack_trees). Returns [N] f32 raw scores (no init score).
     """
-    def one(sf, tb, dl, lc, rc, nl, lv):
-        leaf = route_bins(sf, tb, dl, lc, rc, nl, bins, na_bin, max_steps)
+    has_cat = "is_cat" in tree_stack
+
+    def one(sf, tb, dl, lc, rc, nl, lv, ic=None, cm=None):
+        leaf = route_bins(sf, tb, dl, lc, rc, nl, bins, na_bin, max_steps,
+                          is_cat=ic, cat_mask=cm)
         return lv[leaf]
 
-    per_tree = jax.vmap(one)(
-        tree_stack["split_feature"], tree_stack["threshold_bin"],
-        tree_stack["default_left"], tree_stack["left_child"],
-        tree_stack["right_child"], tree_stack["num_leaves"],
-        tree_stack["leaf_value"])
+    if has_cat:
+        per_tree = jax.vmap(one)(
+            tree_stack["split_feature"], tree_stack["threshold_bin"],
+            tree_stack["default_left"], tree_stack["left_child"],
+            tree_stack["right_child"], tree_stack["num_leaves"],
+            tree_stack["leaf_value"], tree_stack["is_cat"],
+            tree_stack["cat_mask"])
+    else:
+        per_tree = jax.vmap(one)(
+            tree_stack["split_feature"], tree_stack["threshold_bin"],
+            tree_stack["default_left"], tree_stack["left_child"],
+            tree_stack["right_child"], tree_stack["num_leaves"],
+            tree_stack["leaf_value"])
     return per_tree.sum(axis=0)
+
+
+@partial(jax.jit, static_argnames=("max_steps",))
+def leaf_bins_ensemble(tree_stack, bins, na_bin, max_steps: int):
+    """Per-tree leaf indices on binned/pseudo-binned data: [N, T]."""
+    has_cat = "is_cat" in tree_stack
+
+    def one(sf, tb, dl, lc, rc, nl, ic=None, cm=None):
+        return route_bins(sf, tb, dl, lc, rc, nl, bins, na_bin, max_steps,
+                          is_cat=ic, cat_mask=cm)
+
+    if has_cat:
+        out = jax.vmap(one)(
+            tree_stack["split_feature"], tree_stack["threshold_bin"],
+            tree_stack["default_left"], tree_stack["left_child"],
+            tree_stack["right_child"], tree_stack["num_leaves"],
+            tree_stack["is_cat"], tree_stack["cat_mask"])
+    else:
+        out = jax.vmap(one)(
+            tree_stack["split_feature"], tree_stack["threshold_bin"],
+            tree_stack["default_left"], tree_stack["left_child"],
+            tree_stack["right_child"], tree_stack["num_leaves"])
+    return out.T
 
 
 @partial(jax.jit, static_argnames=("max_steps",))

@@ -19,11 +19,12 @@ Histograms are channel-major [3, F, B] (see ops/histogram.py layout rules).
 """
 from __future__ import annotations
 
-from dataclasses import dataclass
+from dataclasses import dataclass, replace as dataclass_replace
 from typing import NamedTuple
 
 import jax
 import jax.numpy as jnp
+import numpy as np
 
 NEG_INF = -1e30
 
@@ -37,12 +38,25 @@ class SplitParams:
     min_data_in_leaf: int = 20
     min_sum_hessian_in_leaf: float = 1e-3
     max_delta_step: float = 0.0
+    # categorical k-subset search (reference: FindBestThresholdCategorical,
+    # feature_histogram.hpp:136-310). cat_features is the STATIC tuple of
+    # categorical feature indices — empty tuple compiles the numerical-only
+    # fast path with zero extra work
+    cat_features: tuple = ()
+    cat_l2: float = 10.0
+    cat_smooth: float = 10.0
+    max_cat_threshold: int = 32
+    max_cat_to_onehot: int = 4
+    min_data_per_group: int = 100
 
 
 class SplitResult(NamedTuple):
     """Best split for one leaf (reference analog: SplitInfo, split_info.hpp:22).
 
-    All fields are scalars (or share the batched leading dims of the input)."""
+    All fields are scalars (or share the batched leading dims of the input).
+    For categorical subset splits (``is_cat``), ``cat_member`` [.., B] marks the
+    bins routed LEFT (the reference's cat_threshold bitset, split_info.hpp:28)
+    and ``bin`` holds the subset size - 1 (the reference's threshold index)."""
     gain: jnp.ndarray          # improvement: gain_l + gain_r - gain_parent; NEG_INF if none
     feature: jnp.ndarray       # i32
     bin: jnp.ndarray           # i32 threshold bin (go left if bin <= threshold)
@@ -50,6 +64,8 @@ class SplitResult(NamedTuple):
     left_g: jnp.ndarray
     left_h: jnp.ndarray
     left_cnt: jnp.ndarray
+    is_cat: jnp.ndarray        # bool
+    cat_member: jnp.ndarray    # [.., B] bool (False rows for numerical splits)
 
 
 def threshold_l1(s, l1):
@@ -128,25 +144,159 @@ def best_split(hist: jnp.ndarray, num_bins: jnp.ndarray, na_bin: jnp.ndarray,
     gain_r = gains_of(zeros)                                     # missing -> right
     gain_l = gains_of(na_stats[..., None])                       # missing -> left
 
+    cat_mask_f = np.zeros(f, dtype=bool)
+    for ci in p.cat_features:
+        if 0 <= ci < f:
+            cat_mask_f[ci] = True
+    cat_mask_dev = jnp.asarray(cat_mask_f)
+
     valid_t = (iota < num_bins[None, :, None] - 1) & (~na_sel) \
-        & feature_mask[None, :, None]                            # [1, F, B]
+        & feature_mask[None, :, None] & (~cat_mask_dev)[None, :, None]
     has_na = na < b
     gain_r = jnp.where(valid_t, gain_r, NEG_INF)
     gain_l = jnp.where(valid_t & has_na, gain_l, NEG_INF)
 
-    gains = jnp.concatenate([gain_r.reshape(L, f * b),
-                             gain_l.reshape(L, f * b)], axis=1)  # [L, 2FB]
+    sections = [gain_r.reshape(L, f * b), gain_l.reshape(L, f * b)]
+
+    # ---- categorical subset planes (reference: FindBestThresholdCategorical,
+    # feature_histogram.hpp:136-310) ----
+    if p.cat_features:
+        cat_idx = np.asarray(sorted(set(ci for ci in p.cat_features
+                                        if 0 <= ci < f)), dtype=np.int32)
+        fc = len(cat_idx)
+        hcat = h3[:, :, cat_idx, :]                              # [L, 3, Fc, B]
+        gch, hch, cch = hcat[:, 0], hcat[:, 1], hcat[:, 2]       # [L, Fc, B]
+        nb_c = num_bins[cat_idx][None, :, None]                  # [1, Fc, 1]
+        iota_c = jnp.arange(b, dtype=jnp.int32)[None, None, :]
+        fm_c = feature_mask[cat_idx][None, :, None]
+        # bin 0 is the other/missing bin (binning.py): always routed RIGHT so
+        # exported bitsets stay exact (reference: NaN/unseen -> right,
+        # tree.h CategoricalDecision)
+        in_range = (iota_c >= 1) & (iota_c < nb_c)
+
+        # --- one-hot scan (num_bins <= max_cat_to_onehot; l2 unchanged) ---
+        oh_allowed = (nb_c <= p.max_cat_to_onehot) & fm_c & in_range
+        rg_oh, rh_oh, rc_oh = (pg[:, None, None] - gch,
+                               ph[:, None, None] - hch,
+                               pc[:, None, None] - cch)
+        ok_oh = ((cch >= p.min_data_in_leaf) & (rc_oh >= p.min_data_in_leaf)
+                 & (hch >= p.min_sum_hessian_in_leaf)
+                 & (rh_oh >= p.min_sum_hessian_in_leaf))
+        gain_oh = leaf_split_gain(gch, hch, p) + leaf_split_gain(rg_oh, rh_oh, p)
+        gain_oh = jnp.where(ok_oh & oh_allowed, gain_oh, NEG_INF)
+
+        # --- sorted k-subset scan ---
+        pc2 = dataclass_replace(p, lambda_l2=p.lambda_l2 + p.cat_l2)
+        subset_allowed = (nb_c > p.max_cat_to_onehot) & fm_c
+        svalid = in_range & (cch >= p.cat_smooth)                # [L, Fc, B]
+        mean = jnp.where(svalid, gch / (hch + p.cat_smooth), jnp.inf)
+        # stable ascending rank without sort (invalid bins rank last)
+        mi = mean[..., :, None]                                  # [L,Fc,B,1]
+        mj = mean[..., None, :]                                  # [L,Fc,1,B]
+        ii = jnp.arange(b)[:, None]
+        jj = jnp.arange(b)[None, :]
+        less = (mj < mi) | ((mj == mi) & (jj < ii))              # [L,Fc,B,B]
+        rank = jnp.sum(jnp.where(less, 1, 0), axis=-1)           # [L,Fc,B]
+        rank = jnp.where(svalid, rank, b + 1)
+        used = jnp.sum(svalid, axis=-1)                          # [L, Fc]
+        # sort by scattering each bin's stats to its rank position (a [B, B]
+        # rank one-hot contraction — no [B(k), B(i)] prefix matrices, which
+        # would be 2GB at B=256), then prefix sums along the sorted axis
+        pos = jnp.arange(b)[None, None, None, :]
+        oh_rank = (rank[..., :, None] == pos).astype(jnp.float32)  # [L,Fc,B,B]
+        sg = jnp.einsum("lfip,lfi->lfp", oh_rank, jnp.where(svalid, gch, 0.0))
+        sh = jnp.einsum("lfip,lfi->lfp", oh_rank, jnp.where(svalid, hch, 0.0))
+        sc = jnp.einsum("lfip,lfi->lfp", oh_rank, jnp.where(svalid, cch, 0.0))
+        cum_g = jnp.cumsum(sg, axis=-1)   # index k = ascending prefix len k+1
+        cum_h = jnp.cumsum(sh, axis=-1)
+        cum_c = jnp.cumsum(sc, axis=-1)
+        tot_g = cum_g[..., -1:]
+        tot_h = cum_h[..., -1:]
+        tot_c = cum_c[..., -1:]
+
+        def desc_prefix(cum, tot):
+            # descending prefix len k+1 = total(valid) - asc prefix(used-k-2)
+            kidx = jnp.arange(b)[None, None, :]
+            j = used[..., None] - kidx - 2
+            gathered = jnp.take_along_axis(cum, jnp.clip(j, 0, b - 1), axis=-1)
+            return tot - jnp.where(j >= 0, gathered, 0.0)
+
+        def subset_gains(lg, lh, lc):
+            rg_, rh_, rc_ = (pg[:, None, None] - lg, ph[:, None, None] - lh,
+                             pc[:, None, None] - lc)
+            max_num_cat = jnp.minimum(p.max_cat_threshold,
+                                      (used[..., None] + 1) // 2)
+            kidx = jnp.arange(b)[None, None, :]
+            ok = ((kidx < jnp.minimum(max_num_cat, used[..., None]))
+                  & (lc >= p.min_data_in_leaf) & (rc_ >= p.min_data_in_leaf)
+                  & (rc_ >= p.min_data_per_group)
+                  & (lh >= p.min_sum_hessian_in_leaf)
+                  & (rh_ >= p.min_sum_hessian_in_leaf) & subset_allowed)
+            gain = leaf_split_gain(lg, lh, pc2) + leaf_split_gain(rg_, rh_, pc2)
+            return jnp.where(ok, gain, NEG_INF)
+
+        asc = (cum_g, cum_h, cum_c)
+        desc = (desc_prefix(cum_g, tot_g), desc_prefix(cum_h, tot_h),
+                desc_prefix(cum_c, tot_c))
+        gain_asc = subset_gains(*asc)
+        gain_desc = subset_gains(*desc)
+        left_asc, left_desc = asc, desc
+        sections += [gain_oh.reshape(L, fc * b), gain_asc.reshape(L, fc * b),
+                     gain_desc.reshape(L, fc * b)]
+
+    gains = jnp.concatenate(sections, axis=1)
     flat = jnp.argmax(gains, axis=1)
     best_gain = jnp.take_along_axis(gains, flat[:, None], axis=1)[:, 0]
-    d = flat // (f * b)
+    d = flat // (f * b)                # 0/1 numerical planes; >= 2 categorical
     rem = flat % (f * b)
     feat = (rem // b).astype(jnp.int32)
     tbin = (rem % b).astype(jnp.int32)
 
     lidx = jnp.arange(L)
+
     def pick(chan):
         base = cum[lidx, chan, feat, tbin]
         return base + jnp.where(d == 1, na_stats[lidx, chan, feat], 0.0)
+
+    left_g_, left_h_, left_c_ = pick(0), pick(1), pick(2)
+    is_cat_res = jnp.zeros(L, dtype=bool)
+    cat_member = jnp.zeros((L, b), dtype=bool)
+
+    if p.cat_features:
+        num_flat = 2 * f * b
+        cflat = jnp.maximum(flat - num_flat, 0)      # index into the cat planes
+        plane = jnp.clip(cflat // (fc * b), 0, 2)
+        crem = cflat % (fc * b)
+        cf = (crem // b).astype(jnp.int32)           # winning cat-feature index
+        ck = (crem % b).astype(jnp.int32)            # bin (onehot) / prefix k
+        is_cat_res = flat >= num_flat
+        feat = jnp.where(is_cat_res, jnp.asarray(cat_idx)[cf], feat)
+        tbin = jnp.where(is_cat_res, ck, tbin)
+
+        rank_w = rank[lidx, cf]                      # [L, B]
+        used_w = used[lidx, cf][:, None]
+        iota_b2 = jnp.arange(b)[None, :]
+        mem_oh = iota_b2 == ck[:, None]
+        mem_asc = rank_w <= ck[:, None]
+        mem_desc = (rank_w >= used_w - ck[:, None] - 1) & (rank_w <= b)
+        cat_member = jnp.where(
+            is_cat_res[:, None],
+            jnp.where((plane == 0)[:, None], mem_oh,
+                      jnp.where((plane == 1)[:, None], mem_asc, mem_desc)),
+            cat_member)
+
+        def cpick(tbl_asc, tbl_desc, oh_src):
+            asc = tbl_asc[lidx, cf, ck]
+            desc = tbl_desc[lidx, cf, ck]
+            ohv = oh_src[lidx, cf, ck]
+            return jnp.where(plane == 0, ohv, jnp.where(plane == 1, asc, desc))
+
+        left_g_ = jnp.where(is_cat_res,
+                            cpick(left_asc[0], left_desc[0], gch), left_g_)
+        left_h_ = jnp.where(is_cat_res,
+                            cpick(left_asc[1], left_desc[1], hch), left_h_)
+        left_c_ = jnp.where(is_cat_res,
+                            cpick(left_asc[2], left_desc[2], cch), left_c_)
 
     parent_gain = leaf_split_gain(pg, ph, p)
     improvement = best_gain - parent_gain
@@ -157,7 +307,11 @@ def best_split(hist: jnp.ndarray, num_bins: jnp.ndarray, na_bin: jnp.ndarray,
         gain=jnp.where(found, improvement, NEG_INF),
         feature=feat,
         bin=tbin,
-        default_left=(d == 1),
-        left_g=pick(0), left_h=pick(1), left_cnt=pick(2),
+        default_left=(d == 1) & ~is_cat_res,
+        left_g=left_g_, left_h=left_h_, left_cnt=left_c_,
+        is_cat=is_cat_res,
+        cat_member=cat_member & is_cat_res[:, None],
     )
-    return SplitResult(*[v.reshape(batch_shape) for v in res])
+    return SplitResult(*[
+        v.reshape(batch_shape + v.shape[1:] if v.ndim > 1 else batch_shape)
+        for v in res])

@@ -1,0 +1,148 @@
+"""Categorical k-subset split tests (VERDICT r1 missing #2 / ADVICE high #1:
+subset splits end-to-end, reference-format serialization, and exact save/load
+parity without a train_set)."""
+import numpy as np
+import pytest
+
+from sklearn.metrics import roc_auc_score
+
+import lightgbm_tpu as lgb
+from lightgbm_tpu.models.tree import Tree
+
+_P = {"verbosity": -1, "num_leaves": 15, "min_data_in_leaf": 5}
+
+
+def _subset_problem(n=1200, seed=0):
+    """Positive class = categories {5, 40}: non-contiguous in count order, so
+    an ordinal split over count-ordered bins cannot separate them in one cut
+    but a k-subset split can."""
+    rng = np.random.RandomState(seed)
+    cats = np.array([5, 9, 23, 40, 77])
+    c = rng.choice(cats, size=n, p=[0.3, 0.25, 0.2, 0.15, 0.1])
+    y = np.isin(c, [5, 40]).astype(float)
+    # flip a little noise so it's not perfectly separable
+    flip = rng.rand(n) < 0.05
+    y = np.where(flip, 1 - y, y)
+    X = np.stack([c.astype(float), rng.randn(n)], axis=1)
+    return X, y
+
+
+def test_subset_beats_ordinal_single_split():
+    X, y = _subset_problem()
+    # single split (num_leaves=2): subset must separate {5,40}; ordinal cannot
+    ds = lgb.Dataset(X, label=y, categorical_feature=[0])
+    bst = lgb.train({**_P, "num_leaves": 2, "objective": "binary"},
+                    ds, num_boost_round=1)
+    t = bst._ensure_host_trees()[0]
+    assert t.num_leaves == 2 and t.is_cat_node[0]
+    assert set(t.cat_sets[0]) == {5, 40} or set(t.cat_sets[0]) == {9, 23, 77}
+    auc_cat = roc_auc_score(y, bst.predict(X))
+
+    ds2 = lgb.Dataset(X, label=y)  # ordinal (numerical) treatment
+    bst2 = lgb.train({**_P, "num_leaves": 2, "objective": "binary"},
+                     ds2, num_boost_round=1)
+    auc_ord = roc_auc_score(y, bst2.predict(X))
+    assert auc_cat > 0.94
+    assert auc_cat > auc_ord + 0.05
+
+
+def test_categorical_save_load_parity_without_train_set(tmp_path):
+    """ADVICE r1 high #1: loaded categorical models were silently corrupted
+    (ordinal fallback). The pseudo-bin path must route bit-identically."""
+    X, y = _subset_problem(seed=1)
+    ds = lgb.Dataset(X, label=y, categorical_feature=[0])
+    bst = lgb.train({**_P, "objective": "binary"}, ds, num_boost_round=15)
+    pred0 = bst.predict(X)
+    path = str(tmp_path / "cat_model.txt")
+    bst.save_model(path)
+    loaded = lgb.Booster(model_file=path)   # no train_set attached
+    np.testing.assert_array_equal(np.asarray(loaded.predict(X)),
+                                  np.asarray(pred0))
+    # unseen category and NaN must route right (reference: unseen/NaN -> right)
+    Xu = np.array([[999.0, 0.0], [np.nan, 0.0]])
+    np.testing.assert_array_equal(loaded.predict(Xu), bst.predict(Xu))
+
+
+def test_categorical_model_text_format():
+    X, y = _subset_problem(seed=2)
+    ds = lgb.Dataset(X, label=y, categorical_feature=[0])
+    bst = lgb.train({**_P, "objective": "binary"}, ds, num_boost_round=3)
+    txt = bst.model_to_string()
+    assert "num_cat=" in txt
+    # at least one tree has categorical nodes with bitset fields
+    assert "cat_boundaries=" in txt and "cat_threshold=" in txt
+    # decision_type bit0 set on cat nodes
+    t = bst._ensure_host_trees()[0]
+    assert t.num_cat > 0
+    # bitset round-trip: parse back and compare cat sets
+    block = txt.split("Tree=0")[1].split("\n\nTree=")[0]
+    t2 = Tree.from_string("Tree=0" + block)
+    for i in range(t.num_leaves - 1):
+        assert t2.is_cat_node[i] == t.is_cat_node[i]
+        if t.is_cat_node[i]:
+            np.testing.assert_array_equal(np.sort(t2.cat_sets[i]),
+                                          np.sort(t.cat_sets[i]))
+
+
+def test_max_cat_to_onehot():
+    """Few categories -> one-vs-rest scan (reference use_onehot path)."""
+    rng = np.random.RandomState(3)
+    n = 600
+    c = rng.choice([1, 2, 3], size=n)
+    y = (c == 2).astype(float)
+    X = np.stack([c.astype(float), rng.randn(n)], axis=1)
+    ds = lgb.Dataset(X, label=y, categorical_feature=[0])
+    bst = lgb.train({**_P, "num_leaves": 2, "objective": "binary",
+                     "max_cat_to_onehot": 8}, ds, num_boost_round=1)
+    t = bst._ensure_host_trees()[0]
+    assert t.is_cat_node[0]
+    assert list(t.cat_sets[0]) == [2]    # single-category (one-hot) subset
+    assert roc_auc_score(y, bst.predict(X)) > 0.99
+
+
+def test_categorical_json_dump():
+    X, y = _subset_problem(seed=4)
+    ds = lgb.Dataset(X, label=y, categorical_feature=[0])
+    bst = lgb.train({**_P, "objective": "binary"}, ds, num_boost_round=2)
+    d = bst.dump_model()
+    def find_cat(node):
+        if "leaf_index" in node:
+            return False
+        if node["decision_type"] == "==":
+            assert "||" in str(node["threshold"]) or str(node["threshold"]).isdigit()
+            return True
+        return (find_cat(node["left_child"]) or find_cat(node["right_child"]))
+    assert any(find_cat(ti["tree_structure"]) for ti in d["tree_info"])
+
+
+def test_categorical_cpp_codegen_compiles(tmp_path):
+    import os
+    import subprocess
+    X, y = _subset_problem(seed=5)
+    ds = lgb.Dataset(X, label=y, categorical_feature=[0])
+    bst = lgb.train({**_P, "objective": "binary"}, ds, num_boost_round=3)
+    from lightgbm_tpu.io.model_text import model_to_cpp
+    code = model_to_cpp(bst, bst._ensure_host_trees())
+    src = tmp_path / "m.cpp"
+    main = tmp_path / "main.cpp"
+    src.write_text(code)
+    main.write_text("""
+#include <cstdio>
+void Predict(const double* features, double* output);
+int main() {
+  double row[2]; double out[1];
+  while (scanf("%lf %lf", &row[0], &row[1]) == 2) {
+    Predict(row, out);
+    printf("%.17g\\n", out[0]);
+  }
+  return 0;
+}
+""")
+    exe = str(tmp_path / "pred")
+    subprocess.run(["g++", "-O1", "-o", exe, str(src), str(main)], check=True)
+    inp = "\n".join(f"{a:.17g} {b:.17g}" for a, b in X[:50])
+    out = subprocess.run([exe], input=inp, capture_output=True, text=True,
+                         check=True)
+    cpp_pred = np.array([float(s) for s in out.stdout.split()])
+    raw = np.asarray(bst.predict(X[:50], raw_score=True))
+    np.testing.assert_allclose(cpp_pred, raw, rtol=2e-5, atol=1e-6)
