@@ -91,7 +91,8 @@ class GBDT:
                 cat_l2=config.cat_l2, cat_smooth=config.cat_smooth,
                 max_cat_threshold=config.max_cat_threshold,
                 max_cat_to_onehot=config.max_cat_to_onehot,
-                min_data_per_group=config.min_data_per_group),
+                min_data_per_group=config.min_data_per_group,
+                monotone_constraints=self._monotone_tuple(config, train_set)),
             hist_impl=config.histogram_impl,
         )
         self._bag_rng = np.random.RandomState(config.bagging_seed)
@@ -114,6 +115,20 @@ class GBDT:
             self._bins_dp = shard_rows(jnp.asarray(padded), self._mesh)
             self._pad_rows = padded.shape[0] - self._n_orig
             log.info(f"data-parallel tree learner over {nd} devices")
+
+    @staticmethod
+    def _monotone_tuple(config, train_set) -> tuple:
+        """Map raw-column monotone constraints to used-feature order
+        (trivial features are dropped at binning, so indices shift)."""
+        mc = list(config.monotone_constraints or [])
+        if not any(mc):
+            return ()
+        fm = train_set.feature_map
+        if fm is None:
+            out = mc
+        else:
+            out = [mc[int(orig)] if int(orig) < len(mc) else 0 for orig in fm]
+        return tuple(int(v) for v in out)
 
     # ---- valid sets (reference: GBDT::AddValidDataset, gbdt.cpp) ----
     def add_valid(self, valid_set, name: str) -> None:

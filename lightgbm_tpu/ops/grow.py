@@ -74,13 +74,15 @@ class TreeArrays(NamedTuple):
 
 class _GrowState(NamedTuple):
     leaf_id: jnp.ndarray         # [N] i32
-    hist: jnp.ndarray            # [L, F, B, 3]
+    hist: jnp.ndarray            # [L, 3, F, B]
     leaf_g: jnp.ndarray          # [L]
     leaf_h: jnp.ndarray
     leaf_cnt: jnp.ndarray
     leaf_depth: jnp.ndarray      # [L] i32
     parent_node: jnp.ndarray     # [L] i32: node whose child slot points at leaf
     parent_right: jnp.ndarray    # [L] bool
+    leaf_min: jnp.ndarray        # [L] monotone output bounds
+    leaf_max: jnp.ndarray
     best: SplitResult            # arrays [L]
     tree: TreeArrays
     done: jnp.ndarray            # scalar bool
@@ -158,6 +160,8 @@ def grow_tree(bins: jnp.ndarray, g: jnp.ndarray, h: jnp.ndarray, c: jnp.ndarray,
         leaf_depth=jnp.zeros(L, jnp.int32),
         parent_node=jnp.full(L, -1, jnp.int32),
         parent_right=jnp.zeros(L, dtype=bool),
+        leaf_min=jnp.full(L, -jnp.inf),
+        leaf_max=jnp.full(L, jnp.inf),
         best=best, tree=_empty_tree(L, B), done=jnp.bool_(L < 2),
     )
 
@@ -189,6 +193,7 @@ def grow_tree(bins: jnp.ndarray, g: jnp.ndarray, h: jnp.ndarray, c: jnp.ndarray,
             lg, lh, lc = st.best.left_g[l], st.best.left_h[l], st.best.left_cnt[l]
             pg, ph, pc = st.leaf_g[l], st.leaf_h[l], st.leaf_cnt[l]
             rg, rh, rc = pg - lg, ph - lh, pc - lc
+            lmin_p, lmax_p = st.leaf_min[l], st.leaf_max[l]
 
             # ---- smaller-child histogram + sibling by subtraction ----
             small_is_left = lc <= rc
@@ -216,6 +221,10 @@ def grow_tree(bins: jnp.ndarray, g: jnp.ndarray, h: jnp.ndarray, c: jnp.ndarray,
             w_l = leaf_output(lg, lh, sp)
             w_r = leaf_output(rg, rh, sp)
             w_p = leaf_output(pg, ph, sp)
+            if sp.has_monotone:
+                w_l = jnp.clip(w_l, lmin_p, lmax_p)
+                w_r = jnp.clip(w_r, lmin_p, lmax_p)
+                w_p = jnp.clip(w_p, lmin_p, lmax_p)
             tr = TreeArrays(
                 split_feature=tr.split_feature.at[t].set(feat),
                 threshold_bin=tr.threshold_bin.at[t].set(thr),
@@ -234,6 +243,25 @@ def grow_tree(bins: jnp.ndarray, g: jnp.ndarray, h: jnp.ndarray, c: jnp.ndarray,
                 cat_mask=tr.cat_mask.at[t].set(st.best.cat_member[l]),
             )
 
+            # ---- monotone bound propagation for the two children ----
+            if sp.has_monotone:
+                mono_tab = jnp.zeros(f, jnp.int32).at[
+                    jnp.arange(len(sp.monotone_constraints[:f]))].set(
+                    jnp.asarray(sp.monotone_constraints[:f], jnp.int32))
+                mf = jnp.where(st.best.is_cat[l], 0, mono_tab[feat])
+                mid = (w_l + w_r) / 2.0
+                lmin_l = jnp.where(mf < 0, jnp.maximum(lmin_p, mid), lmin_p)
+                lmax_l = jnp.where(mf > 0, jnp.minimum(lmax_p, mid), lmax_p)
+                lmin_r = jnp.where(mf > 0, jnp.maximum(lmin_p, mid), lmin_p)
+                lmax_r = jnp.where(mf < 0, jnp.minimum(lmax_p, mid), lmax_p)
+                ch_min = jnp.stack([lmin_l, lmin_r])
+                ch_max = jnp.stack([lmax_l, lmax_r])
+                leaf_min2 = st.leaf_min.at[l].set(lmin_l).at[new_leaf].set(lmin_r)
+                leaf_max2 = st.leaf_max.at[l].set(lmax_l).at[new_leaf].set(lmax_r)
+            else:
+                ch_min = ch_max = None
+                leaf_min2, leaf_max2 = st.leaf_min, st.leaf_max
+
             # ---- best splits for the two children (batched, not vmapped) ----
             depth = st.leaf_depth[l] + 1
             allow = _allow_depth(depth, gp) if gp.max_depth > 0 else jnp.bool_(True)
@@ -242,7 +270,8 @@ def grow_tree(bins: jnp.ndarray, g: jnp.ndarray, h: jnp.ndarray, c: jnp.ndarray,
             ch_h = jnp.stack([lh, rh])
             ch_c = jnp.stack([lc, rc])
             bs = best_split(ch_hist, num_bins, na_bin, ch_g, ch_h, ch_c,
-                            feature_mask, sp, allow)
+                            feature_mask, sp, allow,
+                            leaf_min=ch_min, leaf_max=ch_max)
 
             def upd(arr, vals):
                 return arr.at[l].set(vals[0]).at[new_leaf].set(vals[1])
@@ -257,6 +286,7 @@ def grow_tree(bins: jnp.ndarray, g: jnp.ndarray, h: jnp.ndarray, c: jnp.ndarray,
                 leaf_depth=st.leaf_depth.at[l].set(depth).at[new_leaf].set(depth),
                 parent_node=st.parent_node.at[l].set(t).at[new_leaf].set(t),
                 parent_right=st.parent_right.at[l].set(False).at[new_leaf].set(True),
+                leaf_min=leaf_min2, leaf_max=leaf_max2,
                 best=best2, tree=tr, done=st.done,
             )
 

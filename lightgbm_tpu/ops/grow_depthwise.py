@@ -48,6 +48,8 @@ class _DWState(NamedTuple):
     active: jnp.ndarray       # [L] bool: frontier (may still split)
     parent_node: jnp.ndarray  # [L] i32
     parent_right: jnp.ndarray # [L] bool
+    leaf_min: jnp.ndarray     # [L] monotone output bounds (ConstraintEntry)
+    leaf_max: jnp.ndarray
     tree: TreeArrays
 
 
@@ -94,6 +96,8 @@ def grow_tree_depthwise(bins: jnp.ndarray, g: jnp.ndarray, h: jnp.ndarray,
         active=jnp.zeros(L, bool).at[0].set(True),
         parent_node=jnp.full(L, -1, jnp.int32),
         parent_right=jnp.zeros(L, bool),
+        leaf_min=jnp.full(L, -jnp.inf),
+        leaf_max=jnp.full(L, jnp.inf),
         tree=_empty_tree(L, B),
     )
     # root leaf value (kept if nothing splits)
@@ -108,7 +112,8 @@ def grow_tree_depthwise(bins: jnp.ndarray, g: jnp.ndarray, h: jnp.ndarray,
     def level(st: _DWState, SLOTS: int):
         # ---- best split for every frontier leaf (one batched kernel) ----
         res = best_split(st.hist, num_bins, na_bin, st.leaf_g, st.leaf_h,
-                         st.leaf_c, feature_mask, sp, st.active)
+                         st.leaf_c, feature_mask, sp, st.active,
+                         leaf_min=st.leaf_min, leaf_max=st.leaf_max)
 
         # ---- budgeted selection (num_leaves cap): top-gain candidates win.
         # rank by pairwise comparison count instead of argsort — an [L] sort
@@ -137,6 +142,12 @@ def grow_tree_depthwise(bins: jnp.ndarray, g: jnp.ndarray, h: jnp.ndarray,
         w_l = leaf_output(lg, lh, sp)
         w_r = leaf_output(rg, rh, sp)
         w_p = leaf_output(st.leaf_g, st.leaf_h, sp)
+        if sp.has_monotone:
+            # clamp outputs by the leaf's bounds (CalculateSplittedLeafOutput
+            # with ConstraintEntry, feature_histogram.hpp:498)
+            w_l = jnp.clip(w_l, st.leaf_min, st.leaf_max)
+            w_r = jnp.clip(w_r, st.leaf_min, st.leaf_max)
+            w_p = jnp.clip(w_p, st.leaf_min, st.leaf_max)
         # parent child-pointer fixup
         has_par = sel & (st.parent_node >= 0)
         lc_arr = _scatter_set(tr.left_child, st.parent_node,
@@ -205,6 +216,32 @@ def grow_tree_depthwise(bins: jnp.ndarray, g: jnp.ndarray, h: jnp.ndarray,
         hist2 = hist2.at[jnp.where(slot_used, new_leaf_of_slot, _OOB)].set(
             hist_right, mode="drop")
 
+        # ---- monotone bound propagation (LeafConstraints::UpdateConstraints,
+        # monotone_constraints.hpp:44-58): children inherit the parent entry;
+        # a split on a monotone feature pins the midpoint between them ----
+        if sp.has_monotone:
+            mono_tab = jnp.zeros(f, jnp.int32)
+            mc = jnp.asarray(sp.monotone_constraints[:f], jnp.int32)
+            mono_tab = mono_tab.at[jnp.arange(mc.shape[0])].set(mc)
+            mf = jnp.where(res.is_cat, 0, mono_tab[feat])   # cat splits: none
+            mid = (w_l + w_r) / 2.0
+            lmin_l = jnp.where(sel & (mf < 0), jnp.maximum(st.leaf_min, mid),
+                               st.leaf_min)
+            lmax_l = jnp.where(sel & (mf > 0), jnp.minimum(st.leaf_max, mid),
+                               st.leaf_max)
+            lmin_r = jnp.where(sel & (mf > 0), jnp.maximum(st.leaf_min, mid),
+                               st.leaf_min)
+            lmax_r = jnp.where(sel & (mf < 0), jnp.minimum(st.leaf_max, mid),
+                               st.leaf_max)
+            leaf_min2 = _scatter_set(
+                _scatter_set(st.leaf_min, leaves_iota, lmin_l, sel),
+                new_leaf, lmin_r, sel)
+            leaf_max2 = _scatter_set(
+                _scatter_set(st.leaf_max, leaves_iota, lmax_l, sel),
+                new_leaf, lmax_r, sel)
+        else:
+            leaf_min2, leaf_max2 = st.leaf_min, st.leaf_max
+
         # ---- per-leaf stats / frontier update ----
         leaf_g2 = _scatter_set(_scatter_set(st.leaf_g, leaves_iota, lg, sel),
                                new_leaf, rg, sel)
@@ -222,6 +259,7 @@ def grow_tree_depthwise(bins: jnp.ndarray, g: jnp.ndarray, h: jnp.ndarray,
         return _DWState(
             leaf_id=leaf_id2, hist=hist2, leaf_g=leaf_g2, leaf_h=leaf_h2,
             leaf_c=leaf_c2, active=active2, parent_node=pn2, parent_right=pr2,
+            leaf_min=leaf_min2, leaf_max=leaf_max2,
             tree=tr,
         ), num_sel
 

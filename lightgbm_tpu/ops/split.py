@@ -48,6 +48,14 @@ class SplitParams:
     max_cat_threshold: int = 32
     max_cat_to_onehot: int = 4
     min_data_per_group: int = 100
+    # per-feature monotone constraints (-1/0/+1), STATIC tuple; empty = off
+    # (reference: monotone_constraints.hpp ConstraintEntry + the direction
+    # filter in FindBestThresholdSequence)
+    monotone_constraints: tuple = ()
+
+    @property
+    def has_monotone(self) -> bool:
+        return any(m != 0 for m in self.monotone_constraints)
 
 
 class SplitResult(NamedTuple):
@@ -83,6 +91,13 @@ def leaf_output(sum_g, sum_h, p: SplitParams):
     return w
 
 
+def leaf_gain_given_output(sum_g, sum_h, output, p: SplitParams):
+    """Gain when the leaf output is fixed (clamped by monotone bounds) —
+    reference: GetLeafSplitGainGivenOutput, feature_histogram.hpp:508."""
+    sg = threshold_l1(sum_g, p.lambda_l1)
+    return -(2.0 * sg * output + (sum_h + p.lambda_l2) * output * output)
+
+
 def leaf_split_gain(sum_g, sum_h, p: SplitParams):
     """Gain contribution of a leaf (reference: GetLeafSplitGain,
     feature_histogram.hpp:485). No 1/2 factor, matching the reference so that
@@ -97,7 +112,7 @@ def leaf_split_gain(sum_g, sum_h, p: SplitParams):
 def best_split(hist: jnp.ndarray, num_bins: jnp.ndarray, na_bin: jnp.ndarray,
                parent_g, parent_h, parent_cnt,
                feature_mask: jnp.ndarray, p: SplitParams,
-               allow_split=True) -> SplitResult:
+               allow_split=True, leaf_min=None, leaf_max=None) -> SplitResult:
     """Find the best split for one leaf or a whole frontier of leaves.
 
     hist: [..., 3, F, B] channel-major (grad, hess, count); num_bins: [F] i32
@@ -115,6 +130,16 @@ def best_split(hist: jnp.ndarray, num_bins: jnp.ndarray, na_bin: jnp.ndarray,
     ph = jnp.broadcast_to(jnp.asarray(parent_h, jnp.float32), batch_shape).reshape(L)
     pc = jnp.broadcast_to(jnp.asarray(parent_cnt, jnp.float32), batch_shape).reshape(L)
     allow = jnp.broadcast_to(jnp.asarray(allow_split, bool), batch_shape).reshape(L)
+    if p.has_monotone:
+        lmin = (jnp.broadcast_to(jnp.asarray(leaf_min, jnp.float32), batch_shape)
+                .reshape(L, 1, 1) if leaf_min is not None
+                else jnp.full((L, 1, 1), -jnp.inf))
+        lmax = (jnp.broadcast_to(jnp.asarray(leaf_max, jnp.float32), batch_shape)
+                .reshape(L, 1, 1) if leaf_max is not None
+                else jnp.full((L, 1, 1), jnp.inf))
+        mono = np.zeros(f, dtype=np.int32)
+        mono[: len(p.monotone_constraints)] = p.monotone_constraints[:f]
+        mono_dev = jnp.asarray(mono)[None, :, None]
 
     iota = jnp.arange(b, dtype=jnp.int32)[None, None, :]          # [1, 1, B]
     na = na_bin[None, :, None]                                    # [1, F, 1]
@@ -137,7 +162,18 @@ def best_split(hist: jnp.ndarray, num_bins: jnp.ndarray, na_bin: jnp.ndarray,
         ok = ((lc >= p.min_data_in_leaf) & (rc >= p.min_data_in_leaf)
               & (lh >= p.min_sum_hessian_in_leaf)
               & (rh >= p.min_sum_hessian_in_leaf))
-        gain = leaf_split_gain(lg, lh, p) + leaf_split_gain(rg, rh, p)
+        if p.has_monotone:
+            # clamped-output gains + direction filter (reference:
+            # GetSplitGains w/ ConstraintEntry, feature_histogram.hpp:435-466)
+            wl = jnp.clip(leaf_output(lg, lh, p), lmin, lmax)
+            wr = jnp.clip(leaf_output(rg, rh, p), lmin, lmax)
+            gain = (leaf_gain_given_output(lg, lh, wl, p)
+                    + leaf_gain_given_output(rg, rh, wr, p))
+            viol = (((mono_dev > 0) & (wl > wr))
+                    | ((mono_dev < 0) & (wl < wr)))
+            ok = ok & ~viol
+        else:
+            gain = leaf_split_gain(lg, lh, p) + leaf_split_gain(rg, rh, p)
         return jnp.where(ok, gain, NEG_INF)
 
     zeros = jnp.zeros((L, 3, f, 1), jnp.float32)

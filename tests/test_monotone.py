@@ -1,0 +1,75 @@
+"""Monotone constraint tests (VERDICT r1 missing #5: the param was parsed and
+silently ignored — worse than absent)."""
+import numpy as np
+import pytest
+
+import lightgbm_tpu as lgb
+
+_P = {"verbosity": -1, "num_leaves": 31, "min_data_in_leaf": 10}
+
+
+def _problem(seed=0, n=2000):
+    rng = np.random.RandomState(seed)
+    X = rng.rand(n, 3)
+    # y increases with x0, decreases with x1, arbitrary in x2 — plus noise
+    # strong enough that an unconstrained model violates monotonicity
+    y = 3 * X[:, 0] - 3 * X[:, 1] + np.sin(8 * X[:, 2]) + rng.randn(n) * 0.7
+    return X, y
+
+
+def _check_monotone(bst, feature, direction, n_grid=50, n_probe=20):
+    rng = np.random.RandomState(1)
+    grid = np.linspace(0.01, 0.99, n_grid)
+    for _ in range(n_probe):
+        base = rng.rand(3)
+        rows = np.tile(base, (n_grid, 1))
+        rows[:, feature] = grid
+        pred = np.asarray(bst.predict(rows))
+        diffs = np.diff(pred)
+        if direction > 0:
+            assert (diffs >= -1e-9).all(), f"not increasing in f{feature}"
+        else:
+            assert (diffs <= 1e-9).all(), f"not decreasing in f{feature}"
+
+
+@pytest.mark.parametrize("grow_policy", ["depthwise", "lossguide"])
+def test_monotone_constraints_enforced(grow_policy):
+    X, y = _problem()
+    ds = lgb.Dataset(X, label=y)
+    bst = lgb.train({**_P, "objective": "regression",
+                     "grow_policy": grow_policy,
+                     "monotone_constraints": [1, -1, 0]},
+                    ds, num_boost_round=30)
+    _check_monotone(bst, 0, +1)
+    _check_monotone(bst, 1, -1)
+
+
+def test_unconstrained_violates():
+    """Sanity: without constraints the same problem is NOT monotone
+    (otherwise the test above proves nothing)."""
+    X, y = _problem()
+    ds = lgb.Dataset(X, label=y)
+    bst = lgb.train({**_P, "objective": "regression"}, ds, num_boost_round=30)
+    rng = np.random.RandomState(1)
+    grid = np.linspace(0.01, 0.99, 50)
+    violated = False
+    for _ in range(20):
+        base = rng.rand(3)
+        rows = np.tile(base, (50, 1))
+        rows[:, 0] = grid
+        pred = np.asarray(bst.predict(rows))
+        if (np.diff(pred) < -1e-9).any():
+            violated = True
+            break
+    assert violated
+
+
+def test_monotone_still_learns():
+    X, y = _problem(seed=2)
+    ds = lgb.Dataset(X, label=y)
+    bst = lgb.train({**_P, "objective": "regression",
+                     "monotone_constraints": [1, -1, 0]},
+                    ds, num_boost_round=40)
+    pred = np.asarray(bst.predict(X))
+    resid = y - pred
+    assert np.var(resid) < 0.7 * np.var(y)
