@@ -75,6 +75,7 @@ class Dataset:
         self.categorical_feature = categorical_feature
         self.free_raw_data = free_raw_data
         self._constructed = False
+        self.bundle_meta = None   # set by construct() when EFB bundles
         # filled by construct():
         self.mappers: List[BinMapper] = []
         self.feature_map: Optional[np.ndarray] = None
@@ -126,6 +127,10 @@ class Dataset:
             bins = np.zeros(used.shape, dtype=np.uint8)
             for k in range(used.shape[1]):
                 bins[:, k] = ref.mappers[k].values_to_bins(used[:, k]).astype(np.uint8)
+            self.bundle_meta = getattr(ref, "bundle_meta", None)
+            if self.bundle_meta is not None:
+                from .efb import apply_bundles
+                bins = apply_bundles(bins, self.bundle_meta)
             self._finish_device(bins, ref.num_bins_dev, ref.na_bin_dev,
                                 ref.missing_type_dev, ref.max_num_bins)
             return self
@@ -145,15 +150,36 @@ class Dataset:
         binned = bin_data(raw, mappers)
         self.mappers = binned.mappers
         self.feature_map = binned.feature_map
+        self.bundle_meta = None
+        if conf.enable_bundle and binned.bins.shape[1] >= 3:
+            from .efb import apply_bundles, plan_bundles
+            meta = plan_bundles(binned.bins, self.mappers,
+                                max_conflict_rate=conf.max_conflict_rate,
+                                sparse_threshold=conf.sparse_threshold,
+                                seed=conf.data_random_seed)
+            if meta is not None:
+                self.bundle_meta = meta
+                self._bins_unbundled = binned.bins
+                binned.bins = apply_bundles(binned.bins, meta)
         if self.feature_name != "auto" and isinstance(self.feature_name, (list, tuple)):
             self._names = list(self.feature_name)
         elif columns is not None:
             self._names = [str(c) for c in columns]
         else:
             self._names = [f"Column_{i}" for i in range(raw.shape[1])]
-        num_bins = np.array([m.num_bins for m in self.mappers], dtype=np.int32)
-        na_bin = np.array([m.na_bin for m in self.mappers], dtype=np.int32)
-        mtypes = np.array([m.missing_type for m in self.mappers], dtype=np.int32)
+        if self.bundle_meta is not None:
+            meta = self.bundle_meta
+            num_bins = meta.num_bins.astype(np.int32)
+            na_bin = np.array(
+                [self.mappers[mem[0][0]].na_bin if len(mem) == 1 else -1
+                 for mem in meta.members], dtype=np.int32)
+            mtypes = np.array(
+                [self.mappers[mem[0][0]].missing_type if len(mem) == 1 else 0
+                 for mem in meta.members], dtype=np.int32)
+        else:
+            num_bins = np.array([m.num_bins for m in self.mappers], dtype=np.int32)
+            na_bin = np.array([m.na_bin for m in self.mappers], dtype=np.int32)
+            mtypes = np.array([m.missing_type for m in self.mappers], dtype=np.int32)
         maxb = int(num_bins.max()) if len(num_bins) else 1
         self._finish_device(binned.bins, jnp.asarray(num_bins), jnp.asarray(na_bin),
                             jnp.asarray(mtypes), maxb)

@@ -1,0 +1,216 @@
+"""Exclusive Feature Bundling (EFB).
+
+Reference: ``Dataset::FindGroups`` (src/io/dataset.cpp:92-215, greedy
+conflict-bounded grouping) and ``FastFeatureBundling`` (:215-319) with
+``FeatureGroup`` (src/io/feature_group.h:21) providing offset-stacked bins.
+
+TPU-first re-design: bundling happens ONCE at ingest on the host, in *bin*
+space — mutually-sparse features share a single uint8 column where feature
+``j``'s non-default bins occupy a contiguous position range (ascending
+original-bin order, default bin skipped) and bundle bin 0 means "every member
+at its default". The device pipeline (histograms, growers) sees only the
+bundled matrix. The split search derives virtual per-feature candidates
+directly from the bundle histogram's cumsum:
+
+    candidate at position p ("orig_bin <= pos_bin[p]"):
+      left(p) = (cum[p] - cum[start-1])                       # range prefix
+              + [pos_bin[p] >= default_bin] * (parent - range_total)
+
+(rows of other members and bundle bin 0 carry the sub-feature's default bin,
+so they join the left side exactly when the threshold covers the default).
+The "threshold == default bin" candidate (the zero-vs-nonzero split, crucial
+for sparse features) rides in the otherwise-degenerate range-end position via
+a precomputed ``prefix_end`` indirection. The chosen split routes as a
+bin-subset mask over the bundle column — the same membership machinery
+categorical splits use — and is decoded back to (original feature, real
+threshold) at tree finalization, so saved models are indistinguishable from
+unbundled training.
+
+Only numerical features without a NaN bin and with a dominant default bin are
+bundled; categorical features never are.
+"""
+from __future__ import annotations
+
+from dataclasses import dataclass
+from typing import List, Optional, Tuple
+
+import numpy as np
+
+from .binning import BIN_CATEGORICAL, MISSING_NONE, BinMapper
+from .utils import log
+
+
+@dataclass
+class BundleMeta:
+    """Static description of the bundled feature space (arrays [F_b, 256])."""
+    members: List[List[Tuple[int, int, int]]]  # per column: (feat, off, n_bins)
+    default_bin: np.ndarray   # [F_orig] default (most frequent) bin per feature
+    pos_feat: np.ndarray      # original feature at each bundle position
+    pos_bin: np.ndarray       # original THRESHOLD bin of the candidate at p
+    range_start: np.ndarray   # first position of the range containing p
+    range_end: np.ndarray     # last position of the range containing p
+    prefix_end: np.ndarray    # last prefix position included by candidate p
+    incl_default: np.ndarray  # bool: candidate at p takes the default side left
+    valid: np.ndarray         # bool: p is a legal split candidate
+    is_bundle: np.ndarray     # [F_b] bool: >= 2 members
+    num_bins: np.ndarray      # [F_b]
+
+    @property
+    def num_columns(self) -> int:
+        return len(self.members)
+
+
+def plan_bundles(bins: np.ndarray, mappers: List[BinMapper],
+                 max_conflict_rate: float = 0.0,
+                 sparse_threshold: float = 0.8,
+                 max_bundle_bins: int = 256,
+                 sample_cnt: int = 50_000,
+                 seed: int = 0) -> Optional[BundleMeta]:
+    """Greedy conflict-bounded bundling plan (FindGroups, dataset.cpp:92).
+
+    Returns None when nothing bundles (dense data keeps its identity layout).
+    """
+    n, f = bins.shape
+    rng = np.random.RandomState(seed)
+    sample_idx = (np.arange(n) if n <= sample_cnt
+                  else rng.choice(n, sample_cnt, replace=False))
+    max_conflicts = int(max_conflict_rate * len(sample_idx))
+
+    default_bin = np.zeros(f, dtype=np.int32)
+    nnz = {}
+    cand = []
+    for j, m in enumerate(mappers):
+        if m.bin_type == BIN_CATEGORICAL or m.missing_type != MISSING_NONE \
+                or m.num_bins < 2:
+            continue
+        cnt = np.bincount(bins[sample_idx, j], minlength=m.num_bins)
+        db = int(cnt.argmax())
+        if cnt[db] / max(len(sample_idx), 1) < sparse_threshold:
+            continue
+        default_bin[j] = db
+        nnz[j] = np.nonzero(bins[sample_idx, j] != db)[0]
+        cand.append((j, len(nnz[j])))
+    if len(cand) < 2:
+        return None
+
+    # greedy first-fit by nonzero count desc (dataset.cpp:120-180)
+    cand.sort(key=lambda t: -t[1])
+    bundles: List[List[int]] = []
+    bundle_conflict: List[int] = []
+    bundle_bins: List[int] = []
+    bundle_rows: List[np.ndarray] = []
+    for j, _cnt in cand:
+        extra_bins = mappers[j].num_bins - 1
+        placed = False
+        for bi in range(len(bundles)):
+            if bundle_bins[bi] + extra_bins > max_bundle_bins - 1:
+                continue
+            inter = np.intersect1d(bundle_rows[bi], nnz[j],
+                                   assume_unique=True).size
+            if bundle_conflict[bi] + inter <= max_conflicts:
+                bundles[bi].append(j)
+                bundle_conflict[bi] += inter
+                bundle_bins[bi] += extra_bins
+                bundle_rows[bi] = np.union1d(bundle_rows[bi], nnz[j])
+                placed = True
+                break
+        if not placed:
+            bundles.append([j])
+            bundle_conflict.append(0)
+            bundle_bins.append(extra_bins)
+            bundle_rows.append(nnz[j])
+
+    multi = [sorted(b) for b in bundles if len(b) >= 2]
+    if not multi:
+        return None
+    bundled_feats = set(j for b in multi for j in b)
+    singles = [j for j in range(f) if j not in bundled_feats]
+
+    columns: List[List[Tuple[int, int, int]]] = []
+    for j in singles:
+        columns.append([(j, 0, mappers[j].num_bins)])
+    for b in multi:
+        offs = 1
+        mem = []
+        for j in b:
+            mem.append((j, offs, mappers[j].num_bins))
+            offs += mappers[j].num_bins - 1
+        columns.append(mem)
+
+    fb = len(columns)
+    B = 256
+    pos_feat = np.zeros((fb, B), dtype=np.int32)
+    pos_bin = np.zeros((fb, B), dtype=np.int32)
+    range_start = np.zeros((fb, B), dtype=np.int32)
+    range_end = np.zeros((fb, B), dtype=np.int32)
+    prefix_end = np.zeros((fb, B), dtype=np.int32)
+    incl_default = np.zeros((fb, B), dtype=bool)
+    valid = np.zeros((fb, B), dtype=bool)
+    is_bundle = np.zeros(fb, dtype=bool)
+    num_bins = np.zeros(fb, dtype=np.int32)
+    for c, mem in enumerate(columns):
+        if len(mem) == 1:
+            j, _, nb = mem[0]
+            num_bins[c] = nb
+            pos_feat[c, :] = j
+            pos_bin[c, :B] = np.arange(B)
+            range_end[c, :] = nb - 1
+            continue   # single columns use the normal numerical scan
+        is_bundle[c] = True
+        num_bins[c] = 1 + sum(nb - 1 for _, _, nb in mem)
+        pos_feat[c, :] = mem[0][0]
+        for j, off, nb in mem:
+            db = int(default_bin[j])
+            end = off + nb - 2
+            ob = [bb for bb in range(nb) if bb != db]  # ascending, db skipped
+            pos_feat[c, off:end + 1] = j
+            pos_bin[c, off:end + 1] = ob
+            range_start[c, off:end + 1] = off
+            range_end[c, off:end + 1] = end
+            prefix_end[c, off:end + 1] = np.arange(off, end + 1)
+            incl_default[c, off:end + 1] = np.asarray(ob) >= db
+            # candidates at p < end: threshold t = ob[p - off] (prefix through
+            # p; default side joins left iff t > db). The interior positions
+            # are all valid; p == end would be degenerate...
+            valid[c, off:end] = True
+            if db < nb - 1:
+                # ...so it hosts the "t == db" candidate instead: prefix =
+                # bins < db (positions off .. off+db-1) plus the default side
+                valid[c, end] = True
+                pos_bin[c, end] = db
+                prefix_end[c, end] = off + db - 1   # off-1 when db == 0
+                incl_default[c, end] = True
+            # db == nb-1: p == end is the ordinary t = nb-2 candidate
+            else:
+                valid[c, end] = True
+    meta = BundleMeta(members=columns, default_bin=default_bin,
+                      pos_feat=pos_feat, pos_bin=pos_bin,
+                      range_start=range_start, range_end=range_end,
+                      prefix_end=prefix_end, incl_default=incl_default,
+                      valid=valid, is_bundle=is_bundle, num_bins=num_bins)
+    log.info(f"EFB: bundled {len(bundled_feats)} sparse features into "
+             f"{len(multi)} columns ({f} -> {fb} total)")
+    return meta
+
+
+def apply_bundles(bins: np.ndarray, meta: BundleMeta) -> np.ndarray:
+    """Build the bundled uint8 matrix from the original binned matrix
+    (FastFeatureBundling / FeatureGroup::bin_offsets analog)."""
+    n = bins.shape[0]
+    out = np.zeros((n, meta.num_columns), dtype=np.uint8)
+    for c, mem in enumerate(meta.members):
+        if len(mem) == 1:
+            out[:, c] = bins[:, mem[0][0]]
+            continue
+        col = np.zeros(n, dtype=np.int32)
+        for j, off, nb in mem:
+            db = int(meta.default_bin[j])
+            bj = bins[:, j].astype(np.int32)
+            nz = bj != db
+            pos = off + np.where(bj < db, bj, bj - 1)
+            # conflicts (two members non-default on one row) are bounded by
+            # max_conflict_rate; the later member wins, like the reference's
+            # ordered push
+            col = np.where(nz, pos, col)
+        out[:, c] = col.astype(np.uint8)
+    return out

@@ -75,8 +75,17 @@ class GBDT:
         maxb = train_set.max_num_bins
         B = 64 if maxb <= 64 else (128 if maxb <= 128 else 256)
         from ..binning import BIN_CATEGORICAL
-        cat_feats = tuple(i for i, m in enumerate(train_set.mappers)
-                          if m.bin_type == BIN_CATEGORICAL)
+        meta = getattr(train_set, "bundle_meta", None)
+        if meta is not None:
+            # grower feature space = bundle columns; categorical features are
+            # never bundled, so they are single-member columns
+            cat_feats = tuple(
+                c for c, mem in enumerate(meta.members)
+                if len(mem) == 1
+                and train_set.mappers[mem[0][0]].bin_type == BIN_CATEGORICAL)
+        else:
+            cat_feats = tuple(i for i, m in enumerate(train_set.mappers)
+                              if m.bin_type == BIN_CATEGORICAL)
         self.gp = GrowParams(
             num_leaves=config.num_leaves,
             max_depth=config.max_depth,
@@ -92,9 +101,20 @@ class GBDT:
                 max_cat_threshold=config.max_cat_threshold,
                 max_cat_to_onehot=config.max_cat_to_onehot,
                 min_data_per_group=config.min_data_per_group,
-                monotone_constraints=self._monotone_tuple(config, train_set)),
+                monotone_constraints=self._monotone_tuple(config, train_set),
+                has_bundles=getattr(train_set, "bundle_meta", None) is not None),
             hist_impl=config.histogram_impl,
         )
+        self._bundle_dev = None
+        if meta is not None:
+            from ..ops.split import BundleArrays
+            self._bundle_dev = BundleArrays(
+                range_start=jnp.asarray(meta.range_start[:, :B]),
+                range_end=jnp.asarray(np.minimum(meta.range_end[:, :B], B - 1)),
+                prefix_end=jnp.asarray(np.minimum(meta.prefix_end[:, :B], B - 1)),
+                incl_default=jnp.asarray(meta.incl_default[:, :B]),
+                valid=jnp.asarray(meta.valid[:, :B]),
+                is_bundle=jnp.asarray(meta.is_bundle))
         self._bag_rng = np.random.RandomState(config.bagging_seed)
         self._feat_rng = np.random.RandomState(config.feature_fraction_seed)
         self._bag_key = jax.random.PRNGKey(config.bagging_seed)
@@ -224,6 +244,7 @@ class GBDT:
         gp = self.gp
         obj = self.objective
         grow_fn = self._grow_fn()
+        bundle = self._bundle_dev
 
         def step(bins, num_bins, na_bin, score, fmask, bag_mask, grad, hess,
                  shrink):
@@ -236,7 +257,8 @@ class GBDT:
                 h = hess if k == 1 else hess[:, cls]
                 tree, leaf_id = grow_fn(bins, g * bag_mask, h * bag_mask,
                                         (bag_mask > 0).astype(g.dtype),
-                                        num_bins, na_bin, fmask, gp)
+                                        num_bins, na_bin, fmask, gp,
+                                        bundle=bundle)
                 if obj is not None:
                     s_cls = new_score if k == 1 else new_score[:, cls]
                     renewed = obj.renew_leaf_values(s_cls, leaf_id, gp.num_leaves)
@@ -372,17 +394,19 @@ class GBDT:
                     grow_fn = grow_tree_depthwise
                 tree_dev, leaf_id = grow_tree_dp(
                     self._bins_dp, gw, hw, cw, ts.num_bins_dev, ts.na_bin_dev,
-                    fmask, self.gp, self._mesh, grow_fn=grow_fn)
+                    fmask, self.gp, self._mesh, grow_fn=grow_fn,
+                    bundle=self._bundle_dev)
                 leaf_id = leaf_id[: self._n_orig]
             elif depthwise:
                 from ..ops.grow_depthwise import grow_tree_depthwise
                 tree_dev, leaf_id = grow_tree_depthwise(
                     ts.bins, gw, hw, cw, ts.num_bins_dev, ts.na_bin_dev,
-                    fmask, self.gp)
+                    fmask, self.gp, bundle=self._bundle_dev)
             else:
                 tree_dev, leaf_id = grow_tree(ts.bins, gw, hw, cw,
                                               ts.num_bins_dev, ts.na_bin_dev,
-                                              fmask, self.gp)
+                                              fmask, self.gp,
+                                              bundle=self._bundle_dev)
             tree_dev = self._finish_tree(tree_dev, leaf_id, cls)
             self.models_dev.append(tree_dev)
             self._update_scores(tree_dev, leaf_id, cls)
@@ -499,7 +523,8 @@ class GBDT:
         while len(self.models_host) < len(self.models_dev):
             i = len(self.models_host)
             t = Tree.from_device(jax.tree_util.tree_map(np.asarray, self.models_dev[i]),
-                                 ts.mappers, ts.feature_map)
+                                 ts.mappers, ts.feature_map,
+                                 bundle_meta=getattr(ts, "bundle_meta", None))
             t.shrinkage = self.learning_rate if not self.average_output else 1.0
             self.models_host.append(t)
         return self.models_host

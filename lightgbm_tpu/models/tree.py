@@ -74,14 +74,31 @@ class Tree:
 
     @staticmethod
     def from_device(arrays, mappers: List[BinMapper],
-                    feature_map: Optional[np.ndarray] = None) -> "Tree":
-        """Build from ops.grow.TreeArrays; maps bin thresholds to real values."""
+                    feature_map: Optional[np.ndarray] = None,
+                    bundle_meta=None) -> "Tree":
+        """Build from ops.grow.TreeArrays; maps bin thresholds to real values.
+
+        With EFB (``bundle_meta``), node features are bundle columns and
+        bundle-subset splits carry is_cat + a bin mask; decode them back to
+        (original feature, real threshold) numerical nodes (efb.py)."""
         nl = int(arrays.num_leaves)
-        sf = np.asarray(arrays.split_feature)
+        sf = np.asarray(arrays.split_feature).copy()
         tb = np.asarray(arrays.threshold_bin)
-        is_cat = np.asarray(arrays.is_cat)
+        is_cat = np.asarray(arrays.is_cat).copy()
         cat_mask = np.asarray(arrays.cat_mask)
         n_int = max(nl - 1, 0)
+        if bundle_meta is not None:
+            for i in range(n_int):
+                c = int(sf[i])
+                if bundle_meta.is_bundle[c] and is_cat[i]:
+                    # bundle-subset node -> numerical on the original feature
+                    p_pos = int(tb[i])
+                    sf[i] = bundle_meta.pos_feat[c, p_pos]
+                    is_cat[i] = False
+                    tb = tb.copy()
+                    tb[i] = bundle_meta.pos_bin[c, p_pos]
+                else:
+                    sf[i] = bundle_meta.members[c][0][0]
         thr_real = np.zeros(n_int)
         mtypes = np.zeros(n_int, dtype=np.int32)
         cat_sets: List[np.ndarray] = []

@@ -112,7 +112,7 @@ def _allow_depth(depth, gp: GrowParams):
 @partial(jax.jit, static_argnames=("gp",))
 def grow_tree(bins: jnp.ndarray, g: jnp.ndarray, h: jnp.ndarray, c: jnp.ndarray,
               num_bins: jnp.ndarray, na_bin: jnp.ndarray,
-              feature_mask: jnp.ndarray, gp: GrowParams
+              feature_mask: jnp.ndarray, gp: GrowParams, bundle=None
               ) -> Tuple[TreeArrays, jnp.ndarray]:
     """Grow one tree.
 
@@ -138,7 +138,8 @@ def grow_tree(bins: jnp.ndarray, g: jnp.ndarray, h: jnp.ndarray, c: jnp.ndarray,
     g0, h0, c0 = hist0[0, 0].sum(), hist0[1, 0].sum(), hist0[2, 0].sum()
 
     best0 = best_split(hist0, num_bins, na_bin, g0, h0, c0, feature_mask, sp,
-                       allow_split=_allow_depth(jnp.int32(0), gp) if gp.max_depth > 0 else True)
+                       allow_split=_allow_depth(jnp.int32(0), gp) if gp.max_depth > 0 else True,
+                       bundle=bundle)
 
     def tile(x, fill):
         return jnp.full((L,), fill, dtype=x.dtype).at[0].set(x)
@@ -180,7 +181,7 @@ def grow_tree(bins: jnp.ndarray, g: jnp.ndarray, h: jnp.ndarray, c: jnp.ndarray,
             col = bins[:, feat].astype(jnp.int32)
             is_na = col == na_bin[feat]
             go_right = jnp.where(is_na, ~dleft, col > thr)
-            if sp.cat_features:
+            if sp.cat_features or sp.has_bundles:
                 from .gather import take_small
                 iscat = st.best.is_cat[l]
                 memrow = st.best.cat_member[l].astype(jnp.float32)
@@ -271,7 +272,7 @@ def grow_tree(bins: jnp.ndarray, g: jnp.ndarray, h: jnp.ndarray, c: jnp.ndarray,
             ch_c = jnp.stack([lc, rc])
             bs = best_split(ch_hist, num_bins, na_bin, ch_g, ch_h, ch_c,
                             feature_mask, sp, allow,
-                            leaf_min=ch_min, leaf_max=ch_max)
+                            leaf_min=ch_min, leaf_max=ch_max, bundle=bundle)
 
             def upd(arr, vals):
                 return arr.at[l].set(vals[0]).at[new_leaf].set(vals[1])

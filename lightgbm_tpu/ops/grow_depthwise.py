@@ -63,7 +63,8 @@ def _scatter_set(arr, idx, val, mask):
 def grow_tree_depthwise(bins: jnp.ndarray, g: jnp.ndarray, h: jnp.ndarray,
                         c: jnp.ndarray, num_bins: jnp.ndarray,
                         na_bin: jnp.ndarray, feature_mask: jnp.ndarray,
-                        gp: GrowParams) -> Tuple[TreeArrays, jnp.ndarray]:
+                        gp: GrowParams, bundle=None
+                        ) -> Tuple[TreeArrays, jnp.ndarray]:
     """Grow one tree level-wise.
 
     bins: [N, F] uint8; g/h/c: [N] f32 grad/hess/in-bag count channels (already
@@ -113,7 +114,8 @@ def grow_tree_depthwise(bins: jnp.ndarray, g: jnp.ndarray, h: jnp.ndarray,
         # ---- best split for every frontier leaf (one batched kernel) ----
         res = best_split(st.hist, num_bins, na_bin, st.leaf_g, st.leaf_h,
                          st.leaf_c, feature_mask, sp, st.active,
-                         leaf_min=st.leaf_min, leaf_max=st.leaf_max)
+                         leaf_min=st.leaf_min, leaf_max=st.leaf_max,
+                         bundle=bundle)
 
         # ---- budgeted selection (num_leaves cap): top-gain candidates win.
         # rank by pairwise comparison count instead of argsort — an [L] sort
@@ -191,10 +193,10 @@ def grow_tree_depthwise(bins: jnp.ndarray, g: jnp.ndarray, h: jnp.ndarray,
             # slot only for the smaller child; larger sibling = parent - smaller
             slot_left=jnp.where(sel & small_is_left, idx_in_lvl, SLOTS),
             slot_right=jnp.where(sel & ~small_is_left, idx_in_lvl, SLOTS),
-            is_cat=(res.is_cat & sel).astype(jnp.int32) if sp.cat_features
-            else None,
+            is_cat=(res.is_cat & sel).astype(jnp.int32)
+            if (sp.cat_features or sp.has_bundles) else None,
             member=(res.cat_member & sel[:, None]).astype(jnp.float32)
-            if sp.cat_features else None,
+            if (sp.cat_features or sp.has_bundles) else None,
         )
         hist_small, leaf_id2 = H.hist_routed(
             bins, g, h, c, st.leaf_id, tables, na_bin, SLOTS, B, gp.hist_impl,

@@ -52,10 +52,24 @@ class SplitParams:
     # (reference: monotone_constraints.hpp ConstraintEntry + the direction
     # filter in FindBestThresholdSequence)
     monotone_constraints: tuple = ()
+    # EFB: bundled columns present (static flag; the BundleArrays data rides
+    # along as a traced argument)
+    has_bundles: bool = False
 
     @property
     def has_monotone(self) -> bool:
         return any(m != 0 for m in self.monotone_constraints)
+
+
+class BundleArrays(NamedTuple):
+    """Traced EFB decode arrays (built from efb.BundleMeta), all [F, B] except
+    is_bundle [F]. See efb.py for the candidate identity."""
+    range_start: jnp.ndarray
+    range_end: jnp.ndarray
+    prefix_end: jnp.ndarray
+    incl_default: jnp.ndarray
+    valid: jnp.ndarray
+    is_bundle: jnp.ndarray
 
 
 class SplitResult(NamedTuple):
@@ -112,7 +126,8 @@ def leaf_split_gain(sum_g, sum_h, p: SplitParams):
 def best_split(hist: jnp.ndarray, num_bins: jnp.ndarray, na_bin: jnp.ndarray,
                parent_g, parent_h, parent_cnt,
                feature_mask: jnp.ndarray, p: SplitParams,
-               allow_split=True, leaf_min=None, leaf_max=None) -> SplitResult:
+               allow_split=True, leaf_min=None, leaf_max=None,
+               bundle=None) -> SplitResult:
     """Find the best split for one leaf or a whole frontier of leaves.
 
     hist: [..., 3, F, B] channel-major (grad, hess, count); num_bins: [F] i32
@@ -188,6 +203,8 @@ def best_split(hist: jnp.ndarray, num_bins: jnp.ndarray, na_bin: jnp.ndarray,
 
     valid_t = (iota < num_bins[None, :, None] - 1) & (~na_sel) \
         & feature_mask[None, :, None] & (~cat_mask_dev)[None, :, None]
+    if p.has_bundles and bundle is not None:
+        valid_t = valid_t & (~bundle.is_bundle)[None, :, None]
     has_na = na < b
     gain_r = jnp.where(valid_t, gain_r, NEG_INF)
     gain_l = jnp.where(valid_t & has_na, gain_l, NEG_INF)
@@ -280,6 +297,41 @@ def best_split(hist: jnp.ndarray, num_bins: jnp.ndarray, na_bin: jnp.ndarray,
         sections += [gain_oh.reshape(L, fc * b), gain_asc.reshape(L, fc * b),
                      gain_desc.reshape(L, fc * b)]
 
+    # ---- EFB virtual-feature plane (efb.py candidate identity) ----
+    if p.has_bundles and bundle is not None:
+        bs1 = (bundle.range_start - 1)[None, None, :, :]       # [1,1,F,B]
+        be1 = bundle.range_end[None, None, :, :]
+        pe1 = bundle.prefix_end[None, None, :, :]
+        cum_start = jnp.take_along_axis(
+            cum, jnp.broadcast_to(jnp.maximum(bs1, 0), cum.shape), axis=-1)
+        cum_end = jnp.take_along_axis(
+            cum, jnp.broadcast_to(be1, cum.shape), axis=-1)
+        cum_pe = jnp.take_along_axis(
+            cum, jnp.broadcast_to(jnp.maximum(pe1, 0), cum.shape), axis=-1)
+        # prefix_end == range_start-1 encodes the empty prefix (t == default
+        # with default bin 0): gather clamps to a valid index, mask to zero
+        prefix = jnp.where((pe1 >= bundle.range_start[None, None, :, :]),
+                           cum_pe - cum_start, 0.0)
+        rng_tot = cum_end - cum_start
+        incl = bundle.incl_default[None, :, :].astype(jnp.float32)
+        par = jnp.stack([pg, ph, pc], axis=1)[:, :, None, None]  # [L,3,1,1]
+        lB = prefix + incl[:, None, :, :] * (par - rng_tot)
+        lgB, lhB, lcB = lB[:, 0], lB[:, 1], lB[:, 2]
+        rgB = pg[:, None, None] - lgB
+        rhB = ph[:, None, None] - lhB
+        rcB = pc[:, None, None] - lcB
+        okB = ((lcB >= p.min_data_in_leaf) & (rcB >= p.min_data_in_leaf)
+               & (lhB >= p.min_sum_hessian_in_leaf)
+               & (rhB >= p.min_sum_hessian_in_leaf)
+               & bundle.valid[None, :, :] & bundle.is_bundle[None, :, None]
+               & feature_mask[None, :, None])
+        if p.has_monotone:
+            gainB = leaf_split_gain(lgB, lhB, p) + leaf_split_gain(rgB, rhB, p)
+        else:
+            gainB = leaf_split_gain(lgB, lhB, p) + leaf_split_gain(rgB, rhB, p)
+        gainB = jnp.where(okB, gainB, NEG_INF)
+        sections.append(gainB.reshape(L, f * b))
+
     gains = jnp.concatenate(sections, axis=1)
     flat = jnp.argmax(gains, axis=1)
     best_gain = jnp.take_along_axis(gains, flat[:, None], axis=1)[:, 0]
@@ -298,14 +350,16 @@ def best_split(hist: jnp.ndarray, num_bins: jnp.ndarray, na_bin: jnp.ndarray,
     is_cat_res = jnp.zeros(L, dtype=bool)
     cat_member = jnp.zeros((L, b), dtype=bool)
 
+    n_num = 2 * f * b
+    n_cat = 3 * fc * b if p.cat_features else 0
     if p.cat_features:
-        num_flat = 2 * f * b
+        num_flat = n_num
         cflat = jnp.maximum(flat - num_flat, 0)      # index into the cat planes
         plane = jnp.clip(cflat // (fc * b), 0, 2)
         crem = cflat % (fc * b)
         cf = (crem // b).astype(jnp.int32)           # winning cat-feature index
         ck = (crem % b).astype(jnp.int32)            # bin (onehot) / prefix k
-        is_cat_res = flat >= num_flat
+        is_cat_res = (flat >= num_flat) & (flat < num_flat + n_cat)
         feat = jnp.where(is_cat_res, jnp.asarray(cat_idx)[cf], feat)
         tbin = jnp.where(is_cat_res, ck, tbin)
 
@@ -333,6 +387,30 @@ def best_split(hist: jnp.ndarray, num_bins: jnp.ndarray, na_bin: jnp.ndarray,
                             cpick(left_asc[1], left_desc[1], hch), left_h_)
         left_c_ = jnp.where(is_cat_res,
                             cpick(left_asc[2], left_desc[2], cch), left_c_)
+
+    if p.has_bundles and bundle is not None:
+        # ---- EFB winner decode: routes as a bin-subset mask over the bundle
+        # column (decoded to the original feature at tree finalization) ----
+        bundle_base = n_num + n_cat
+        bflat = jnp.maximum(flat - bundle_base, 0)
+        bf = (bflat // b).astype(jnp.int32)
+        bp = (bflat % b).astype(jnp.int32)
+        is_bun = flat >= bundle_base
+        feat = jnp.where(is_bun, bf, feat)
+        tbin = jnp.where(is_bun, bp, tbin)
+        start_w = bundle.range_start[bf, bp]
+        end_w = bundle.range_end[bf, bp]
+        pe_w = bundle.prefix_end[bf, bp]
+        incl_w = bundle.incl_default[bf, bp]
+        iota_b3 = jnp.arange(b)[None, :]
+        mem_b = ((iota_b3 >= start_w[:, None]) & (iota_b3 <= pe_w[:, None])) \
+            | (incl_w[:, None] & ((iota_b3 < start_w[:, None])
+                                  | (iota_b3 > end_w[:, None])))
+        is_cat_res = is_cat_res | is_bun
+        cat_member = jnp.where(is_bun[:, None], mem_b, cat_member)
+        left_g_ = jnp.where(is_bun, lgB[lidx, bf, bp], left_g_)
+        left_h_ = jnp.where(is_bun, lhB[lidx, bf, bp], left_h_)
+        left_c_ = jnp.where(is_bun, lcB[lidx, bf, bp], left_c_)
 
     parent_gain = leaf_split_gain(pg, ph, p)
     improvement = best_gain - parent_gain

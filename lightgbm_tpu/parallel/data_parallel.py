@@ -24,7 +24,8 @@ from .mesh import DATA_AXIS
 
 def grow_tree_dp(bins, g, h, c, num_bins, na_bin, feature_mask,
                  gp: GrowParams, mesh: Mesh,
-                 grow_fn=grow_tree) -> Tuple[TreeArrays, jnp.ndarray]:
+                 grow_fn=grow_tree, bundle=None
+                 ) -> Tuple[TreeArrays, jnp.ndarray]:
     """Grow one tree with rows sharded over ``mesh``'s data axis.
 
     ``grow_fn`` is either ops.grow.grow_tree (leaf-wise) or
@@ -40,7 +41,7 @@ def grow_tree_dp(bins, g, h, c, num_bins, na_bin, feature_mask,
                    axis_name=axis)
 
     fn = jax.shard_map(
-        partial(grow_fn, gp=gp_dp),
+        partial(grow_fn, gp=gp_dp, bundle=bundle),
         mesh=mesh,
         in_specs=(P(axis, None), P(axis), P(axis), P(axis), P(), P(), P()),
         out_specs=(TreeArrays(*([P()] * len(TreeArrays._fields))), P(axis)),
