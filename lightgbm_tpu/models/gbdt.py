@@ -104,6 +104,8 @@ class GBDT:
                 monotone_constraints=self._monotone_tuple(config, train_set),
                 has_bundles=getattr(train_set, "bundle_meta", None) is not None),
             hist_impl=config.histogram_impl,
+            voting_top_k=(config.top_k if config.tree_learner == "voting"
+                          else 0),
         )
         self._bundle_dev = None
         if meta is not None:

@@ -35,6 +35,9 @@ class GrowParams:
     max_bin: int = 255            # padded bin axis length B
     split: SplitParams = SplitParams()
     hist_impl: str = "auto"
+    # voting-parallel: top-k features elected per level for histogram exchange
+    # (reference: VotingParallelTreeLearner, top_k config); 0 = off
+    voting_top_k: int = 0
     # Data-parallel axis (reference: DataParallelTreeLearner,
     # data_parallel_tree_learner.cpp:149-240). When set, rows are sharded over this
     # mesh axis under shard_map and every histogram / root-sum is psum-ed — the

@@ -34,13 +34,15 @@ import jax.numpy as jnp
 
 from . import histogram as H
 from .grow import GrowParams, TreeArrays, _empty_tree, _psum
-from .split import NEG_INF, SplitParams, best_split, leaf_output
+from .split import (NEG_INF, SplitParams, best_split, leaf_output,
+                    per_feature_gains)
 
 _OOB = 1 << 20  # out-of-bounds scatter index (dropped with mode="drop")
 
 
 class _DWState(NamedTuple):
     leaf_id: jnp.ndarray      # [N]
+    vote_mask: jnp.ndarray    # [F] bool: voting-elected features (all-True off)
     hist: jnp.ndarray         # [L, 3, F, B] per-leaf histograms (frontier leaves)
     leaf_g: jnp.ndarray       # [L]
     leaf_h: jnp.ndarray
@@ -90,6 +92,7 @@ def grow_tree_depthwise(bins: jnp.ndarray, g: jnp.ndarray, h: jnp.ndarray,
 
     state = _DWState(
         leaf_id=jnp.zeros(n, dtype=jnp.int32),
+        vote_mask=jnp.ones(f, dtype=bool),
         hist=jnp.zeros((L, 3, f, B), jnp.float32).at[0].set(hist0),
         leaf_g=jnp.zeros(L).at[0].set(g0),
         leaf_h=jnp.zeros(L).at[0].set(h0),
@@ -113,7 +116,7 @@ def grow_tree_depthwise(bins: jnp.ndarray, g: jnp.ndarray, h: jnp.ndarray,
     def level(st: _DWState, SLOTS: int):
         # ---- best split for every frontier leaf (one batched kernel) ----
         res = best_split(st.hist, num_bins, na_bin, st.leaf_g, st.leaf_h,
-                         st.leaf_c, feature_mask, sp, st.active,
+                         st.leaf_c, feature_mask & st.vote_mask, sp, st.active,
                          leaf_min=st.leaf_min, leaf_max=st.leaf_max,
                          bundle=bundle)
 
@@ -183,34 +186,86 @@ def grow_tree_depthwise(bins: jnp.ndarray, g: jnp.ndarray, h: jnp.ndarray,
             cat_mask=_scatter_set(tr.cat_mask, node_id, res.cat_member, sel),
         )
 
-        # ---- fused route + smaller-child histogram pass ----
+        # ---- fused route + child histogram pass ----
+        voting = bool(gp.axis_name) and gp.voting_top_k > 0
         small_is_left = lc <= rc
+        if voting:
+            # voting mode measures BOTH children fresh (no sibling
+            # subtraction): the next level's vote needs full local histograms
+            # of the whole frontier, and parent-derived entries would mix
+            # earlier elected sets (shard-divergent -> collective deadlock)
+            S_pass = 2 * SLOTS
+            slot_l_tab = jnp.where(sel, idx_in_lvl * 2, S_pass)
+            slot_r_tab = jnp.where(sel, idx_in_lvl * 2 + 1, S_pass)
+        else:
+            S_pass = SLOTS
+            # slot only for the smaller child; larger sibling = parent - smaller
+            slot_l_tab = jnp.where(sel & small_is_left, idx_in_lvl, SLOTS)
+            slot_r_tab = jnp.where(sel & ~small_is_left, idx_in_lvl, SLOTS)
         tables = H.RouteTables(
             feat=jnp.where(sel, feat, -1),
             thr=thr,
             dleft=dleft.astype(jnp.int32),
             new_leaf=new_leaf,
-            # slot only for the smaller child; larger sibling = parent - smaller
-            slot_left=jnp.where(sel & small_is_left, idx_in_lvl, SLOTS),
-            slot_right=jnp.where(sel & ~small_is_left, idx_in_lvl, SLOTS),
+            slot_left=slot_l_tab,
+            slot_right=slot_r_tab,
             is_cat=(res.is_cat & sel).astype(jnp.int32)
             if (sp.cat_features or sp.has_bundles) else None,
             member=(res.cat_member & sel[:, None]).astype(jnp.float32)
             if (sp.cat_features or sp.has_bundles) else None,
         )
-        hist_small, leaf_id2 = H.hist_routed(
-            bins, g, h, c, st.leaf_id, tables, na_bin, SLOTS, B, gp.hist_impl,
+        hist_pass, leaf_id2 = H.hist_routed(
+            bins, g, h, c, st.leaf_id, tables, na_bin, S_pass, B, gp.hist_impl,
             bins_T=bins_T)
-        hist_small = _psum(hist_small, gp)
+        if voting:
+            # ---- voting-parallel histogram exchange (PV-Tree; reference:
+            # VotingParallelTreeLearner GlobalVoting + CopyLocalHistogram,
+            # voting_parallel_tree_learner.cpp:170-366). Per-LEVEL election
+            # (the depthwise analog of the reference's per-leaf vote): each
+            # shard votes its local top-2k features by best local frontier
+            # gains, the tally is all-reduced, and only the top-k elected
+            # features' histograms are exchanged — compressing the per-level
+            # collective from F*B to k*B columns.
+            k = min(gp.voting_top_k, f)
+            k2 = min(2 * k, f)
+            lg_local = per_feature_gains(
+                hist_pass, num_bins, na_bin,
+                hist_pass[:, 0, 0].sum(-1), hist_pass[:, 1, 0].sum(-1),
+                hist_pass[:, 2, 0].sum(-1), sp)            # [S_pass, F]
+            score = jnp.where(lg_local > NEG_INF / 2, lg_local, 0.0).sum(0)
+            # local top-2k one-hot vote, tallied across shards
+            thresh2 = jax.lax.top_k(score, k2)[0][-1]
+            votes = (score >= thresh2).astype(jnp.float32)
+            votes = jax.lax.psum(votes, gp.axis_name)
+            # deterministic global election: top-k by (votes, score-sum)
+            global_score = jax.lax.psum(score, gp.axis_name)
+            elect_key = votes * 1e12 + global_score
+            elected = jax.lax.top_k(elect_key, k)[1]       # [k] feature ids
+            sub = jnp.take(hist_pass, elected, axis=2)     # [S_pass, 3, k, B]
+            sub = jax.lax.psum(sub, gp.axis_name)
+            vote_mask = jnp.zeros(f, bool).at[elected].set(True)
+            # non-elected entries must NOT keep local (shard-divergent)
+            # values: state feeds the replicated split selection and the loop
+            # predicates — divergence deadlocks the collectives. Zero them.
+            hist_pass = jnp.where(vote_mask[None, None, :, None],
+                                  hist_pass.at[:, :, elected, :].set(sub),
+                                  0.0)
+        else:
+            hist_pass = _psum(hist_pass, gp)
+            vote_mask = None
 
         leaf_of_slot = _scatter_set(jnp.full(SLOTS, _OOB, jnp.int32),
                                     idx_in_lvl, leaves_iota, sel)
         slot_used = leaf_of_slot < L
-        parent_hist = st.hist[jnp.minimum(leaf_of_slot, L - 1)]  # [SLOTS,...]
-        hist_sib = parent_hist - hist_small
-        sl = small_is_left[jnp.minimum(leaf_of_slot, L - 1)][:, None, None, None]
-        hist_left = jnp.where(sl, hist_small, hist_sib)
-        hist_right = jnp.where(sl, hist_sib, hist_small)
+        if voting:
+            hist_left = hist_pass[0::2][:SLOTS]
+            hist_right = hist_pass[1::2][:SLOTS]
+        else:
+            parent_hist = st.hist[jnp.minimum(leaf_of_slot, L - 1)]  # [SLOTS,..]
+            hist_sib = parent_hist - hist_pass
+            sl = small_is_left[jnp.minimum(leaf_of_slot, L - 1)][:, None, None, None]
+            hist_left = jnp.where(sl, hist_pass, hist_sib)
+            hist_right = jnp.where(sl, hist_sib, hist_pass)
         new_leaf_of_slot = _scatter_set(jnp.full(SLOTS, _OOB, jnp.int32),
                                         idx_in_lvl, new_leaf, sel)
         hist2 = st.hist.at[jnp.where(slot_used, leaf_of_slot, _OOB)].set(
@@ -259,7 +314,9 @@ def grow_tree_depthwise(bins: jnp.ndarray, g: jnp.ndarray, h: jnp.ndarray,
             new_leaf, jnp.ones(L, bool), sel)
 
         return _DWState(
-            leaf_id=leaf_id2, hist=hist2, leaf_g=leaf_g2, leaf_h=leaf_h2,
+            leaf_id=leaf_id2,
+            vote_mask=st.vote_mask if vote_mask is None else vote_mask,
+            hist=hist2, leaf_g=leaf_g2, leaf_h=leaf_h2,
             leaf_c=leaf_c2, active=active2, parent_node=pn2, parent_right=pr2,
             leaf_min=leaf_min2, leaf_max=leaf_max2,
             tree=tr,

@@ -123,6 +123,39 @@ def leaf_split_gain(sum_g, sum_h, p: SplitParams):
     return -(2.0 * sg * w + (sum_h + p.lambda_l2) * w * w)
 
 
+def per_feature_gains(hist: jnp.ndarray, num_bins: jnp.ndarray,
+                      na_bin: jnp.ndarray, parent_g, parent_h, parent_cnt,
+                      p: SplitParams) -> jnp.ndarray:
+    """Per-feature best numerical gain [.., F] — the voting score for the
+    voting-parallel learner (reference: LightSplitInfo gains fed to
+    GlobalVoting, voting_parallel_tree_learner.cpp:170). Numerical planes
+    only: votes are a heuristic pre-filter, not the final split search."""
+    batch_shape = hist.shape[:-3]
+    _, f, b = hist.shape[-3:]
+    L = 1
+    for d in batch_shape:
+        L *= d
+    h3 = hist.reshape(L, 3, f, b)
+    pg = jnp.broadcast_to(jnp.asarray(parent_g, jnp.float32), batch_shape).reshape(L)
+    ph = jnp.broadcast_to(jnp.asarray(parent_h, jnp.float32), batch_shape).reshape(L)
+    pc = jnp.broadcast_to(jnp.asarray(parent_cnt, jnp.float32), batch_shape).reshape(L)
+    iota = jnp.arange(b, dtype=jnp.int32)[None, None, :]
+    na = na_bin[None, :, None]
+    na_sel = iota == na
+    cum = jnp.cumsum(jnp.where(na_sel[:, None, :, :], 0.0, h3), axis=3)
+    lg, lh, lc = cum[:, 0], cum[:, 1], cum[:, 2]
+    rg = pg[:, None, None] - lg
+    rh = ph[:, None, None] - lh
+    rc = pc[:, None, None] - lc
+    ok = ((lc >= p.min_data_in_leaf) & (rc >= p.min_data_in_leaf)
+          & (lh >= p.min_sum_hessian_in_leaf)
+          & (rh >= p.min_sum_hessian_in_leaf)
+          & (iota < num_bins[None, :, None] - 1) & (~na_sel))
+    gain = leaf_split_gain(lg, lh, p) + leaf_split_gain(rg, rh, p)
+    gain = jnp.where(ok, gain, NEG_INF)
+    return gain.max(axis=-1).reshape(batch_shape + (f,))
+
+
 def best_split(hist: jnp.ndarray, num_bins: jnp.ndarray, na_bin: jnp.ndarray,
                parent_g, parent_h, parent_cnt,
                feature_mask: jnp.ndarray, p: SplitParams,

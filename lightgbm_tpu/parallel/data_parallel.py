@@ -34,11 +34,10 @@ def grow_tree_dp(bins, g, h, c, num_bins, na_bin, feature_mask,
     already be sharded along rows; the returned TreeArrays are replicated,
     leaf_id stays row-sharded.
     """
+    import dataclasses
     axis = mesh.axis_names[0]
     gp_dp = gp if gp.axis_name == axis else \
-        GrowParams(num_leaves=gp.num_leaves, max_depth=gp.max_depth,
-                   max_bin=gp.max_bin, split=gp.split, hist_impl=gp.hist_impl,
-                   axis_name=axis)
+        dataclasses.replace(gp, axis_name=axis)
 
     fn = jax.shard_map(
         partial(grow_fn, gp=gp_dp, bundle=bundle),
