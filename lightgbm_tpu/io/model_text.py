@@ -67,11 +67,13 @@ def dump_model_text(booster, trees: List[Tree], num_iteration: int = -1,
     ]
     lines = [l for l in lines if l is not None]
 
-    tree_blocks = [t.to_string(i) for i, t in enumerate(trees)]
-    tree_sizes = [len(b) + 1 for b in tree_blocks]  # +1: blank separator line
+    # reference byte convention (gbdt_model_text.cpp:313-325): each block is
+    # "Tree=i\n" + Tree::ToString() + "\n" and tree_sizes is its exact length
+    tree_blocks = [t.to_string(i) + "\n" for i, t in enumerate(trees)]
+    tree_sizes = [len(b) for b in tree_blocks]
     lines.insert(len(lines) - 1, f"tree_sizes={' '.join(str(s) for s in tree_sizes)}")
 
-    body = "\n".join(lines) + "\n".join(tree_blocks) + "\nend of trees\n"
+    body = "\n".join(lines) + "".join(tree_blocks) + "end of trees\n"
 
     # feature importances (split counts), like the reference's footer
     imp = {}
@@ -85,8 +87,12 @@ def dump_model_text(booster, trees: List[Tree], num_iteration: int = -1,
         nm = names[f] if f < len(names) else f"Column_{f}"
         body += f"{nm}={c}\n"
     body += "\nparameters:\n"
-    for key, val in sorted(booster.params.items()):
-        body += f"[{key}: {val}]\n"
+    loaded_block = (booster._loaded_meta or {}).get("parameters_block")
+    if loaded_block is not None:
+        body += loaded_block
+    else:
+        for key, val in sorted(booster.params.items()):
+            body += f"[{key}: {val}]\n"
     body += "end of parameters\n\npandas_categorical:null\n"
     return body
 
@@ -94,6 +100,11 @@ def dump_model_text(booster, trees: List[Tree], num_iteration: int = -1,
 def parse_model_text(s: str) -> Tuple[Dict, List[Tree]]:
     header, _, rest = s.partition("\nTree=")
     meta: Dict = {}
+    # retain the original parameters footer for byte-stable re-save
+    # (reference keeps loaded_parameter_, gbdt_model_text.cpp:559)
+    if "\nparameters:\n" in s:
+        meta["parameters_block"] = s.split("\nparameters:\n", 1)[1].split(
+            "end of parameters")[0]
     for line in header.splitlines():
         line = line.strip()
         if not line or line == "tree":

@@ -265,13 +265,13 @@ class Tree:
                  f"internal_weight={arr(self.internal_weight, '%g')}",
                  f"internal_count={arr(self.internal_count, '%d')}",
                  f"shrinkage={self.shrinkage:g}",
-                 ""]
+                 "", ""]
         if cat_idx > 0:
             ins = [f"cat_boundaries={arr(cat_boundaries, '%d')}",
                    f"cat_threshold={arr(cat_words, '%d')}"]
-            # insert after decision_type line (reference field order)
+            # after internal_count, before shrinkage (tree.cpp:238-243)
             pos = next(i for i, ln in enumerate(lines)
-                       if ln.startswith("left_child="))
+                       if ln.startswith("shrinkage="))
             lines[pos:pos] = ins
         return "\n".join(lines)
 
