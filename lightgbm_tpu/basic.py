@@ -142,11 +142,18 @@ class Dataset:
         if _PANDAS and isinstance(self.raw_data, pd.DataFrame):
             # encode pandas categoricals as their code (reference: basic.py:313-400)
             raw = raw.copy()
+        forced_bins = None
+        if conf.forcedbins_filename:
+            # reference: forcedbins_filename JSON (bin_serializer usage,
+            # dataset_loader.cpp DatasetLoader::CheckDataset forced bins)
+            with open(conf.forcedbins_filename) as fh:
+                forced_bins = {int(e["feature"]): e["bin_upper_bound"]
+                               for e in json.load(fh)}
         mappers = find_bin_mappers(
             raw, max_bin=conf.max_bin, min_data_in_bin=conf.min_data_in_bin,
             sample_cnt=conf.bin_construct_sample_cnt, categorical=cats,
             use_missing=conf.use_missing, zero_as_missing=conf.zero_as_missing,
-            seed=conf.data_random_seed)
+            seed=conf.data_random_seed, forced_bins=forced_bins)
         binned = bin_data(raw, mappers)
         self.mappers = binned.mappers
         self.feature_map = binned.feature_map
@@ -502,6 +509,59 @@ class Booster:
         return obj
 
     # ---- persistence (reference: gbdt_model_text.cpp) ----
+    def refit(self, data, label, decay_rate: Optional[float] = None,
+              weight=None, group=None, **kwargs) -> "Booster":
+        """Refit the existing tree STRUCTURES to new data (reference:
+        Booster.refit -> GBDT::RefitTree, gbdt.cpp:299 +
+        SerialTreeLearner::FitByExistingTree, serial_tree_learner.cpp:196-226):
+        per tree, route the new rows to leaves, recompute the regularized
+        optimal outputs from the new gradients, and blend
+        ``decay * old + (1 - decay) * new``."""
+        conf = params_to_config(self.params)
+        decay = conf.refit_decay_rate if decay_rate is None else decay_rate
+        new_b = Booster(model_str=self.model_to_string(), params=self.params)
+        trees = new_b._ensure_host_trees()
+        if not trees:
+            log.fatal("Cannot refit an empty model")
+        x = _to_numpy_2d(data)
+        y = _to_numpy_1d(label)
+        obj = new_b._objective_for_predict()
+        if obj is None:
+            log.fatal("Cannot refit: model has no objective")
+        obj.init(jnp.asarray(y, dtype=jnp.float32),
+                 None if weight is None else jnp.asarray(_to_numpy_1d(weight),
+                                                         dtype=jnp.float32),
+                 None if group is None else np.asarray(group, dtype=np.int64))
+        k = new_b.num_model_per_iteration()
+        n = x.shape[0]
+        leaf_mat = np.asarray(self.predict(x, pred_leaf=True))      # [N, T]
+        score = (np.zeros(n) if k == 1 else np.zeros((n, k)))
+        grad = hess = None
+        from .ops.split import SplitParams, leaf_output
+        sp = SplitParams(lambda_l1=conf.lambda_l1, lambda_l2=conf.lambda_l2,
+                         max_delta_step=conf.max_delta_step)
+        for ti, t in enumerate(trees):
+            cls = ti % k
+            if cls == 0:
+                g_dev, h_dev = obj.get_gradients(jnp.asarray(score,
+                                                             dtype=jnp.float32))
+                grad, hess = np.asarray(g_dev), np.asarray(h_dev)
+            g = grad if k == 1 else grad[:, cls]
+            h = hess if k == 1 else hess[:, cls]
+            leaf = leaf_mat[:, ti]
+            sg = np.bincount(leaf, weights=g, minlength=t.num_leaves)
+            sh = np.bincount(leaf, weights=h, minlength=t.num_leaves) + 1e-15
+            new_out = np.asarray(leaf_output(jnp.asarray(sg), jnp.asarray(sh),
+                                             sp)) * t.shrinkage
+            t.leaf_value = decay * t.leaf_value + (1.0 - decay) * new_out
+            delta = t.leaf_value[leaf]
+            if k == 1:
+                score = score + delta
+            else:
+                score[:, cls] += delta
+        new_b._pseudo_router = None
+        return new_b
+
     def save_model(self, filename: str, num_iteration: Optional[int] = None,
                    start_iteration: int = 0) -> "Booster":
         with open(filename, "w") as f:

@@ -40,8 +40,19 @@ from .split import (NEG_INF, SplitParams, best_split, leaf_output,
 _OOB = 1 << 20  # out-of-bounds scatter index (dropped with mode="drop")
 
 
+class ForcedSplits(NamedTuple):
+    """Flattened forcedsplits_filename tree (reference: ForceSplits,
+    serial_tree_learner.cpp:456-618): per forced node, the (already
+    bin-mapped) split and child pointers (-1 = stop forcing)."""
+    feat: jnp.ndarray    # [M] i32 (grower feature space)
+    bin: jnp.ndarray     # [M] i32
+    left: jnp.ndarray    # [M] i32 forced-node index of the left child
+    right: jnp.ndarray   # [M] i32
+
+
 class _DWState(NamedTuple):
     leaf_id: jnp.ndarray      # [N]
+    forced_ptr: jnp.ndarray   # [L] i32: forced-node to apply next (-1 none)
     vote_mask: jnp.ndarray    # [F] bool: voting-elected features (all-True off)
     hist: jnp.ndarray         # [L, 3, F, B] per-leaf histograms (frontier leaves)
     leaf_g: jnp.ndarray       # [L]
@@ -65,7 +76,7 @@ def _scatter_set(arr, idx, val, mask):
 def grow_tree_depthwise(bins: jnp.ndarray, g: jnp.ndarray, h: jnp.ndarray,
                         c: jnp.ndarray, num_bins: jnp.ndarray,
                         na_bin: jnp.ndarray, feature_mask: jnp.ndarray,
-                        gp: GrowParams, bundle=None
+                        gp: GrowParams, bundle=None, forced=None
                         ) -> Tuple[TreeArrays, jnp.ndarray]:
     """Grow one tree level-wise.
 
@@ -92,6 +103,8 @@ def grow_tree_depthwise(bins: jnp.ndarray, g: jnp.ndarray, h: jnp.ndarray,
 
     state = _DWState(
         leaf_id=jnp.zeros(n, dtype=jnp.int32),
+        forced_ptr=jnp.full(L, -1, jnp.int32).at[0].set(
+            0 if forced is not None else -1),
         vote_mask=jnp.ones(f, dtype=bool),
         hist=jnp.zeros((L, 3, f, B), jnp.float32).at[0].set(hist0),
         leaf_g=jnp.zeros(L).at[0].set(g0),
@@ -119,6 +132,36 @@ def grow_tree_depthwise(bins: jnp.ndarray, g: jnp.ndarray, h: jnp.ndarray,
                          st.leaf_c, feature_mask & st.vote_mask, sp, st.active,
                          leaf_min=st.leaf_min, leaf_max=st.leaf_max,
                          bundle=bundle)
+        if forced is not None:
+            # ---- forced splits override the gain search (ForceSplits,
+            # serial_tree_learner.cpp:456-618): leaves holding a forced-node
+            # pointer split on that (feature, bin) unconditionally; left
+            # stats come from the leaf histogram's cumsum at the forced bin
+            fp = jnp.maximum(st.forced_ptr, 0)
+            has_f = (st.forced_ptr >= 0) & st.active
+            ffeat = forced.feat[fp]                         # [L]
+            fbin = forced.bin[fp]
+            iota_bf = jnp.arange(B, dtype=jnp.int32)[None, None, :]
+            na_self = iota_bf == na_bin[None, :, None]      # [1, F, B]
+            cumf = jnp.cumsum(jnp.where(na_self[:, None], 0.0, st.hist),
+                              axis=-1)                      # [L, 3, F, B]
+            lidx2 = jnp.arange(L)
+            flg = cumf[lidx2, 0, ffeat, fbin]
+            flh = cumf[lidx2, 1, ffeat, fbin]
+            flc = cumf[lidx2, 2, ffeat, fbin]
+            # validity: both sides non-empty, else stop forcing at this leaf
+            okf = has_f & (flc >= 1) & (st.leaf_c - flc >= 1)
+            big = jnp.float32(1e30)
+            res = res._replace(
+                gain=jnp.where(okf, big, res.gain),
+                feature=jnp.where(okf, ffeat, res.feature),
+                bin=jnp.where(okf, fbin, res.bin),
+                default_left=jnp.where(okf, False, res.default_left),
+                left_g=jnp.where(okf, flg, res.left_g),
+                left_h=jnp.where(okf, flh, res.left_h),
+                left_cnt=jnp.where(okf, flc, res.left_cnt),
+                is_cat=jnp.where(okf, False, res.is_cat),
+                cat_member=jnp.where(okf[:, None], False, res.cat_member))
 
         # ---- budgeted selection (num_leaves cap): top-gain candidates win.
         # rank by pairwise comparison count instead of argsort — an [L] sort
@@ -313,8 +356,19 @@ def grow_tree_depthwise(bins: jnp.ndarray, g: jnp.ndarray, h: jnp.ndarray,
             _scatter_set(st.parent_right, leaves_iota, jnp.zeros(L, bool), sel),
             new_leaf, jnp.ones(L, bool), sel)
 
+        if forced is not None:
+            fl = forced.left[fp]
+            fr = forced.right[fp]
+            fp_next = jnp.where(okf, fl, -1)
+            fptr2 = _scatter_set(
+                _scatter_set(st.forced_ptr, leaves_iota,
+                             jnp.where(sel, fp_next, st.forced_ptr), sel),
+                new_leaf, jnp.where(okf, fr, -1), sel)
+        else:
+            fptr2 = st.forced_ptr
         return _DWState(
             leaf_id=leaf_id2,
+            forced_ptr=fptr2,
             vote_mask=st.vote_mask if vote_mask is None else vote_mask,
             hist=hist2, leaf_g=leaf_g2, leaf_h=leaf_h2,
             leaf_c=leaf_c2, active=active2, parent_node=pn2, parent_right=pr2,

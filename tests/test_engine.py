@@ -233,3 +233,84 @@ def test_predict_feature_count_mismatch():
     bst = lgb.train({**_P, "objective": "regression"}, ds, num_boost_round=3)
     with pytest.raises(lgb.LightGBMError):
         bst.predict(X[:, :4])
+
+
+def test_refit_new_data():
+    """Booster.refit (reference: GBDT::RefitTree + FitByExistingTree)."""
+    rng = np.random.RandomState(21)
+    X = rng.randn(600, 5)
+    y = X[:, 0] * 2 + rng.randn(600) * 0.2
+    bst = lgb.train({**_P, "objective": "regression"},
+                    lgb.Dataset(X, label=y), num_boost_round=10)
+    y2 = y + 5.0
+    rb = bst.refit(X, y2, decay_rate=0.0)
+    assert abs(np.mean(rb.predict(X)) - y2.mean()) < \
+        abs(np.mean(bst.predict(X)) - y2.mean())
+    # structures unchanged, only leaf values differ
+    t0, t1 = bst._ensure_host_trees()[0], rb._ensure_host_trees()[0]
+    np.testing.assert_array_equal(t0.split_feature, t1.split_feature)
+    assert not np.allclose(t0.leaf_value, t1.leaf_value)
+    # decay 1.0 keeps the old model exactly
+    rb1 = bst.refit(X, y2, decay_rate=1.0)
+    np.testing.assert_allclose(np.asarray(rb1.predict(X)),
+                               np.asarray(bst.predict(X)), rtol=1e-6)
+
+
+def test_forced_splits(tmp_path):
+    """forcedsplits_filename forces the top split(s) (reference: ForceSplits,
+    serial_tree_learner.cpp:456-618)."""
+    import json as _json
+    rng = np.random.RandomState(22)
+    X = rng.randn(800, 4)
+    y = X[:, 0] + 0.1 * X[:, 1] + rng.randn(800) * 0.1
+    fs = tmp_path / "forced.json"
+    # force the root to split on the WEAK feature 3 at 0.0, then feature 2 left
+    fs.write_text(_json.dumps({
+        "feature": 3, "threshold": 0.0,
+        "left": {"feature": 2, "threshold": 0.5}}))
+    bst = lgb.train({**_P, "objective": "regression",
+                     "forcedsplits_filename": str(fs)},
+                    lgb.Dataset(X, label=y), num_boost_round=2)
+    for t in bst._ensure_host_trees():
+        assert t.split_feature[0] == 3, "root split must be forced to f3"
+        assert abs(t.threshold_real[0] - 0.0) < 0.2
+        # the forced left child splits on feature 2
+        lc = t.left_child[0]
+        if lc >= 0:
+            assert t.split_feature[lc] == 2
+    # an unforced model would never root-split on the weak feature 3
+    b2 = lgb.train({**_P, "objective": "regression"},
+                   lgb.Dataset(X, label=y), num_boost_round=1)
+    assert b2._ensure_host_trees()[0].split_feature[0] == 0
+
+
+def test_unconsumed_params_warn():
+    import lightgbm_tpu.utils.log as lgb_log
+    msgs = []
+    lgb_log.set_callback(lambda s: msgs.append(s))
+    try:
+        X = np.random.RandomState(23).randn(200, 3)
+        y = X[:, 0]
+        lgb.train({**_P, "verbosity": 0, "objective": "regression",
+                   "cegb_tradeoff": 2.0, "feature_fraction_bynode": 0.5},
+                  lgb.Dataset(X, label=y), num_boost_round=1)
+    finally:
+        lgb_log.set_callback(None)
+    joined = "".join(msgs)
+    assert "cegb_tradeoff is ignored" in joined
+    assert "feature_fraction_bynode is ignored" in joined
+
+
+def test_forced_bins(tmp_path):
+    import json as _json
+    rng = np.random.RandomState(24)
+    X = rng.rand(500, 2)
+    y = X[:, 0]
+    fb = tmp_path / "forced_bins.json"
+    fb.write_text(_json.dumps([{"feature": 0,
+                                "bin_upper_bound": [0.25, 0.5, 0.75]}]))
+    ds = lgb.Dataset(X, label=y, params={"forcedbins_filename": str(fb)})
+    ds.construct()
+    bounds = ds.mappers[0].upper_bounds
+    for v in (0.25, 0.5, 0.75):
+        assert np.any(np.isclose(bounds, v)), f"forced bound {v} missing"
