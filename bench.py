@@ -91,6 +91,62 @@ def main():
           f"train={dt:.1f}s train_auc={auc:.4f} backend={jax.default_backend()}",
           file=sys.stderr)
 
+    if os.environ.get("LGBM_TPU_BENCH_PHASES"):
+        _phase_breakdown(booster, ds, n_rows, file=sys.stderr)
+
+
+def _phase_breakdown(booster, ds, n_rows, file):
+    """Device-time attribution of one boosting iteration (VERDICT r1 item #10):
+    hist (root pass), routed level pass, split search, score update — measured
+    with in-jit repetition so tunnel dispatch latency is subtracted out."""
+    import jax
+    import jax.numpy as jnp
+    from functools import partial as _partial
+    from lightgbm_tpu.ops import histogram as HH
+    from lightgbm_tpu.ops.split import best_split
+    from lightgbm_tpu.ops.gather import take_small
+
+    gb = booster._gbdt
+    gp = gb.gp
+    B = gp.max_bin
+    L = gp.num_leaves
+    bins = ds.bins
+    bins_T = bins.T
+    n, f = bins.shape
+    g = jnp.zeros(n, jnp.float32) + 0.25
+    lid = jnp.zeros(n, jnp.int32)
+    hist_state = jnp.zeros((L, 3, f, B), jnp.float32) + 1.0
+
+    def t_loop(name, op, K=6):
+        def loop(k, x0):
+            return jax.lax.fori_loop(
+                0, k, lambda i, acc: acc + op(acc * 0 + 1 + i * 1e-9), x0)
+        f1 = jax.jit(_partial(loop, 1))
+        fK = jax.jit(_partial(loop, K))
+        x0 = jnp.zeros((), jnp.float32)
+        jax.block_until_ready(f1(x0)); jax.block_until_ready(fK(x0))
+        t0 = time.time(); jax.block_until_ready(f1(x0)); t1 = time.time() - t0
+        t0 = time.time(); jax.block_until_ready(fK(x0)); tK = time.time() - t0
+        print(f"# phase {name}: {(tK - t1) / (K - 1) * 1000:.2f} ms/op",
+              file=file)
+
+    t_loop("hist_root", lambda s: HH.hist_leaf(
+        bins, g * s, g, g, B, gp.hist_impl, bins_T=bins_T).sum())
+    S = min(128, (L + 1) // 2 + 1)
+    tables = HH.RouteTables(
+        feat=jnp.zeros(L, jnp.int32), thr=jnp.full(L, B // 2, jnp.int32),
+        dleft=jnp.zeros(L, jnp.int32), new_leaf=jnp.arange(L, dtype=jnp.int32),
+        slot_left=jnp.zeros(L, jnp.int32), slot_right=jnp.ones(L, jnp.int32))
+    t_loop(f"hist_level_S{S}", lambda s: HH.hist_routed(
+        bins, g * s, g, g, lid, tables, ds.na_bin_dev, S, B,
+        gp.hist_impl, bins_T=bins_T)[0].sum())
+    t_loop("best_split_frontier", lambda s: best_split(
+        hist_state * s, ds.num_bins_dev, ds.na_bin_dev,
+        jnp.ones(L), jnp.ones(L) * 10, jnp.full(L, float(n)),
+        jnp.ones(f, bool), gp.split, jnp.ones(L, bool)).gain.sum())
+    lv = jnp.zeros(L, jnp.float32) + 0.5
+    t_loop("score_update", lambda s: take_small(lv * s, lid).sum())
+
 
 if __name__ == "__main__":
     main()

@@ -52,6 +52,16 @@ def parse_args(argv: List[str]) -> Dict[str, str]:
 
 def _load_dataset(path: str, conf: Config, params: Dict, reference=None,
                   num_features_hint: int = 0) -> Dataset:
+    # binary dataset cache (reference: auto-load of <data>.bin,
+    # application.cpp LoadData + save_binary)
+    bin_path = path if path.endswith(".bin") else path + ".bin"
+    if os.path.exists(bin_path) and reference is None:
+        try:
+            ds = Dataset.load_binary(bin_path, params=params)
+            log.info(f"Loaded binned dataset from {bin_path}")
+            return ds
+        except Exception:
+            pass
     pf = load_file(path, header=conf.header, label_column=conf.label_column,
                    weight_column=conf.weight_column,
                    group_column=conf.group_column,
@@ -60,6 +70,8 @@ def _load_dataset(path: str, conf: Config, params: Dict, reference=None,
     ds = Dataset(pf.X, label=pf.label, weight=pf.weight, group=pf.group,
                  init_score=pf.init_score, reference=reference, params=params,
                  feature_name=pf.feature_names or "auto")
+    if conf.save_binary and reference is None:
+        ds.save_binary(bin_path)
     return ds
 
 

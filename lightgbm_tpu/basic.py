@@ -210,6 +210,62 @@ class Dataset:
         if self.free_raw_data:
             self.raw_data = None
 
+    # ---- binary dataset cache (reference: Dataset::SaveBinaryFile,
+    # dataset.h:424 + DatasetLoader::LoadFromBinFile) ----
+    _BIN_MAGIC = "lgbm_tpu_dataset_v1"
+
+    def save_binary(self, filename: str) -> "Dataset":
+        """Persist the BINNED dataset so re-training skips bin finding
+        (reference: is_save_binary_file / Dataset::SaveBinaryFile)."""
+        self.construct()
+        import pickle
+        payload = {
+            "magic": self._BIN_MAGIC,
+            "bins": np.asarray(self.bins),
+            "num_bins": np.asarray(self.num_bins_dev),
+            "na_bin_raw": np.asarray(self._na_bin_raw),
+            "missing_type": np.asarray(self.missing_type_dev),
+            "max_num_bins": self.max_num_bins,
+            "mappers": self.mappers,
+            "feature_map": self.feature_map,
+            "names": self._names,
+            "label": None if self.label is None else np.asarray(self.label),
+            "weight": None if self.weight is None else np.asarray(self.weight),
+            "group": self.group,
+            "init_score": self.init_score,
+            "bundle_meta": self.bundle_meta,
+            "params": self.params,
+        }
+        with open(filename, "wb") as fh:
+            pickle.dump(payload, fh)
+        log.info(f"Saved binned dataset to {filename}")
+        return self
+
+    @staticmethod
+    def load_binary(filename: str, params=None) -> "Dataset":
+        import pickle
+        with open(filename, "rb") as fh:
+            payload = pickle.load(fh)
+        if payload.get("magic") != Dataset._BIN_MAGIC:
+            log.fatal(f"{filename} is not a lightgbm_tpu binary dataset")
+        ds = Dataset(None, params={**payload["params"], **(params or {})})
+        ds.mappers = payload["mappers"]
+        ds.feature_map = payload["feature_map"]
+        ds._names = payload["names"]
+        ds.label = payload["label"]
+        ds.weight = payload["weight"]
+        ds.group = payload["group"]
+        ds.init_score = payload["init_score"]
+        ds.bundle_meta = payload["bundle_meta"]
+        ds._num_features_raw = (int(ds.feature_map.max()) + 1
+                                if ds.feature_map is not None
+                                else payload["bins"].shape[1])
+        ds._finish_device(payload["bins"], jnp.asarray(payload["num_bins"]),
+                          jnp.asarray(payload["na_bin_raw"]),
+                          jnp.asarray(payload["missing_type"]),
+                          payload["max_num_bins"])
+        return ds
+
     def create_valid(self, data, label=None, weight=None, group=None,
                      init_score=None, params=None) -> "Dataset":
         return Dataset(data, label=label, reference=self, weight=weight,

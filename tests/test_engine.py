@@ -314,3 +314,20 @@ def test_forced_bins(tmp_path):
     bounds = ds.mappers[0].upper_bounds
     for v in (0.25, 0.5, 0.75):
         assert np.any(np.isclose(bounds, v)), f"forced bound {v} missing"
+
+
+def test_dataset_binary_cache(tmp_path):
+    """save_binary/load_binary skip bin finding (reference: SaveBinaryFile)."""
+    rng = np.random.RandomState(25)
+    X = rng.randn(400, 5)
+    y = X[:, 0] + rng.randn(400) * 0.1
+    ds = lgb.Dataset(X, label=y)
+    path = str(tmp_path / "data.bin")
+    ds.save_binary(path)
+    ds2 = lgb.Dataset.load_binary(path)
+    np.testing.assert_array_equal(np.asarray(ds.construct().bins),
+                                  np.asarray(ds2.bins))
+    b1 = lgb.train({**_P, "objective": "regression"}, ds, num_boost_round=5)
+    b2 = lgb.train({**_P, "objective": "regression"}, ds2, num_boost_round=5)
+    np.testing.assert_allclose(np.asarray(b1.predict(X)),
+                               np.asarray(b2.predict(X)), rtol=1e-6)
