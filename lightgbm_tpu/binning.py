@@ -370,17 +370,46 @@ def bin_data(
     mappers: List[BinMapper],
     keep_trivial: bool = False,
 ) -> BinnedDataset:
-    """Encode raw feature matrix into the dense uint8 binned matrix."""
+    """Encode raw feature matrix into the dense uint8 binned matrix.
+
+    The numerical columns go through the native multithreaded binner when the
+    toolchain is available (native/fastio.cpp bin_columns — the reference's
+    BinMapper::ValueToBin hot loop is C++ for the same reason); categorical
+    columns and the no-toolchain case use the NumPy path."""
     n, f = data.shape
     used = [j for j in range(f) if keep_trivial or not mappers[j].is_trivial]
     if not used:
         used = [0] if f else []
-    out = np.zeros((n, len(used)), dtype=np.uint8)
-    for k, j in enumerate(used):
-        b = mappers[j].values_to_bins(data[:, j])
+    for j in used:
         if mappers[j].num_bins > 256:
             log.fatal(f"feature {j}: {mappers[j].num_bins} bins > 256 unsupported")
-        out[:, k] = b.astype(np.uint8)
+    out = np.zeros((n, len(used)), dtype=np.uint8)
+    num_cols = [(k, j) for k, j in enumerate(used)
+                if mappers[j].bin_type == BIN_NUMERICAL]
+    done = set()
+    if num_cols and n * len(num_cols) >= 1 << 16:
+        from .native import bin_values as native_bin_values
+        bounds_list = []
+        na_list = []
+        for _, j in num_cols:
+            m = mappers[j]
+            n_numeric = m.num_bins - (1 if m.missing_type == MISSING_NAN else 0)
+            bounds = m.upper_bounds[:n_numeric]
+            bounds_list.append(bounds)
+            if m.missing_type == MISSING_NAN:
+                na_list.append(m.num_bins - 1)
+            else:  # NaN coerced to the bin holding 0.0
+                na_list.append(int(m.values_to_bins(np.asarray([0.0]))[0]))
+        sub = np.ascontiguousarray(data[:, [j for _, j in num_cols]])
+        res = native_bin_values(sub, bounds_list, na_list)
+        if res is not None:
+            for idx, (k, j) in enumerate(num_cols):
+                out[:, k] = res[:, idx]
+                done.add(k)
+    for k, j in enumerate(used):
+        if k in done:
+            continue
+        out[:, k] = mappers[j].values_to_bins(data[:, j]).astype(np.uint8)
     return BinnedDataset(
         bins=out,
         mappers=[mappers[j] for j in used],

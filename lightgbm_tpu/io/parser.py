@@ -164,23 +164,40 @@ def load_file(path: str, header: bool = False, label_column: str = "",
         return ParsedFile(X, y, sw, sg, si, None)
 
     header_names: Optional[List[str]] = None
-    rows: List[List[str]] = []
-    with open(path, "r") as fh:
+    if header:
+        with open(path, "r") as fh:
+            first_line = fh.readline().rstrip("\n\r")
+        header_names = [t.strip() for t in first_line.split(delim)]
+
+    # native multithreaded parser (native/fastio.cpp, the analog of the
+    # reference's C++ CSVParser/TSVParser); NumPy/Python fallback below
+    from ..native import parse_delimited
+    with open(path, "rb") as fh:
+        raw_bytes = fh.read()
+    mat = parse_delimited(raw_bytes, delim, skip_first=bool(header))
+    if mat is None:
+        rows: List[List[str]] = []
         first = True
-        for raw in fh:
-            s = raw.rstrip("\n\r")
-            if not s.strip():
+        for line in raw_bytes.decode("utf-8", "replace").splitlines():
+            s_line = line.rstrip("\r")
+            if not s_line.strip():
                 continue
-            toks = s.split(delim)
             if first and header:
-                header_names = [t.strip() for t in toks]
                 first = False
                 continue
             first = False
-            rows.append(toks)
-    if not rows:
-        log.fatal(f"Data file {path} has no data rows")
-    ncol = len(rows[0])
+            rows.append(s_line.split(delim))
+        if not rows:
+            log.fatal(f"Data file {path} has no data rows")
+        ncol = len(rows[0])
+        mat = np.empty((len(rows), ncol), dtype=np.float64)
+        for i, toks in enumerate(rows):
+            if len(toks) != ncol:
+                log.fatal(f"{path}: row {i} has {len(toks)} columns, "
+                          f"expected {ncol}")
+            for j, t in enumerate(toks):
+                mat[i, j] = _to_float(t)
+    ncol = mat.shape[1]
 
     label_idx = _resolve_column(label_column, header_names) if label_column \
         else 0
@@ -189,13 +206,6 @@ def load_file(path: str, header: bool = False, label_column: str = "",
     group_idx = _resolve_column(group_column, header_names) if group_column \
         else -1
     ignore = set(_resolve_columns(ignore_column, header_names))
-
-    mat = np.empty((len(rows), ncol), dtype=np.float64)
-    for i, toks in enumerate(rows):
-        if len(toks) != ncol:
-            log.fatal(f"{path}: row {i} has {len(toks)} columns, expected {ncol}")
-        for j, t in enumerate(toks):
-            mat[i, j] = _to_float(t)
 
     label = mat[:, label_idx] if label_idx >= 0 else None
     weight = mat[:, weight_idx] if weight_idx >= 0 else sw
@@ -220,6 +230,12 @@ def _load_libsvm(path: str, num_features_hint: int = 0
                  ) -> Tuple[np.ndarray, np.ndarray]:
     """LibSVM rows: ``label idx:val idx:val ...`` (0- or 1-based indices kept
     as-is, matching the reference's zero_as_missing-friendly dense fill)."""
+    from ..native import parse_libsvm
+    with open(path, "rb") as fh:
+        raw_bytes = fh.read()
+    res = parse_libsvm(raw_bytes, num_features_hint)
+    if res is not None:
+        return res
     labels: List[float] = []
     entries: List[List[Tuple[int, float]]] = []
     max_idx = -1

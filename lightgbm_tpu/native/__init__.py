@@ -1,0 +1,162 @@
+"""Native runtime loader.
+
+Compiles fastio.cpp on first use with the system C++ toolchain (g++ -O3,
+cached next to the source keyed by content hash) and exposes it through
+ctypes — the analog of the reference's compiled C++ IO/binning core
+(src/io/parser.cpp, src/io/bin.cpp), with NumPy fallbacks everywhere so the
+framework keeps working without a toolchain.
+"""
+from __future__ import annotations
+
+import ctypes
+import hashlib
+import os
+import subprocess
+import tempfile
+from typing import Optional
+
+import numpy as np
+
+from ..utils import log
+
+_HERE = os.path.dirname(os.path.abspath(__file__))
+_SRC = os.path.join(_HERE, "fastio.cpp")
+_lib = None
+_tried = False
+
+
+def _build() -> Optional[str]:
+    with open(_SRC, "rb") as fh:
+        digest = hashlib.sha256(fh.read()).hexdigest()[:16]
+    cache_dir = os.environ.get("LGBM_TPU_NATIVE_CACHE",
+                               os.path.join(tempfile.gettempdir(),
+                                            "lgbm_tpu_native"))
+    os.makedirs(cache_dir, exist_ok=True)
+    so_path = os.path.join(cache_dir, f"fastio_{digest}.so")
+    if os.path.exists(so_path):
+        return so_path
+    tmp = so_path + f".tmp{os.getpid()}"
+    cmd = ["g++", "-O3", "-std=c++17", "-shared", "-fPIC", "-pthread",
+           _SRC, "-o", tmp]
+    try:
+        subprocess.run(cmd, check=True, capture_output=True, timeout=120)
+        os.replace(tmp, so_path)
+        return so_path
+    except Exception as e:  # toolchain missing / compile error -> fallback
+        log.debug(f"native fastio build failed ({e}); using NumPy fallbacks")
+        return None
+
+
+def get_lib():
+    """The loaded native library, or None (NumPy fallbacks apply)."""
+    global _lib, _tried
+    if _lib is not None or _tried:
+        return _lib
+    _tried = True
+    if os.environ.get("LGBM_TPU_DISABLE_NATIVE"):
+        return None
+    so = _build()
+    if so is None:
+        return None
+    try:
+        lib = ctypes.CDLL(so)
+        lib.csv_dims.restype = ctypes.c_int64
+        lib.csv_dims.argtypes = [ctypes.c_char_p, ctypes.c_int64,
+                                 ctypes.c_char,
+                                 ctypes.POINTER(ctypes.c_int64)]
+        lib.csv_parse.restype = ctypes.c_int32
+        lib.csv_parse.argtypes = [ctypes.c_char_p, ctypes.c_int64,
+                                  ctypes.c_char, ctypes.c_int64,
+                                  ctypes.c_int64, ctypes.c_int32,
+                                  ctypes.POINTER(ctypes.c_double),
+                                  ctypes.POINTER(ctypes.c_int64)]
+        lib.libsvm_scan.restype = ctypes.c_int64
+        lib.libsvm_scan.argtypes = [ctypes.c_char_p, ctypes.c_int64,
+                                    ctypes.POINTER(ctypes.c_double),
+                                    ctypes.POINTER(ctypes.c_int64),
+                                    ctypes.c_int64,
+                                    ctypes.POINTER(ctypes.c_int64)]
+        lib.libsvm_fill.restype = ctypes.c_int32
+        lib.libsvm_fill.argtypes = [ctypes.c_char_p, ctypes.c_int64,
+                                    ctypes.c_int64, ctypes.c_int64,
+                                    ctypes.POINTER(ctypes.c_double)]
+        lib.bin_columns.restype = None
+        lib.bin_columns.argtypes = [ctypes.POINTER(ctypes.c_double),
+                                    ctypes.c_int64, ctypes.c_int64,
+                                    ctypes.POINTER(ctypes.c_double),
+                                    ctypes.POINTER(ctypes.c_int64),
+                                    ctypes.POINTER(ctypes.c_int32),
+                                    ctypes.POINTER(ctypes.c_uint8)]
+        _lib = lib
+    except Exception as e:
+        log.debug(f"native fastio load failed ({e}); using NumPy fallbacks")
+        _lib = None
+    return _lib
+
+
+def _dptr(a):
+    return a.ctypes.data_as(ctypes.POINTER(ctypes.c_double))
+
+
+def parse_delimited(raw: bytes, delim: str, skip_first: bool) -> Optional[np.ndarray]:
+    """Parse a CSV/TSV byte buffer to an [N, C] f64 matrix, or None if the
+    native lib is unavailable (caller falls back to the Python parser)."""
+    lib = get_lib()
+    if lib is None:
+        return None
+    ncols = ctypes.c_int64(0)
+    nrows = lib.csv_dims(raw, len(raw), delim.encode()[0:1], ctypes.byref(ncols))
+    if skip_first:
+        nrows -= 1
+    if nrows <= 0 or ncols.value <= 0:
+        return None
+    out = np.empty((nrows, ncols.value), dtype=np.float64)
+    bad = ctypes.c_int64(-1)
+    rc = lib.csv_parse(raw, len(raw), delim.encode()[0:1], nrows, ncols.value,
+                       1 if skip_first else 0, _dptr(out), ctypes.byref(bad))
+    if rc != 0:
+        log.fatal(f"native parser: row {bad.value} has the wrong column count")
+    return out
+
+
+def parse_libsvm(raw: bytes, num_features_hint: int = 0):
+    """Parse a LibSVM byte buffer to (X dense [N, F] f64, labels [N])."""
+    lib = get_lib()
+    if lib is None:
+        return None
+    # count rows cheaply: non-empty lines
+    approx_rows = raw.count(b"\n") + 1
+    labels = np.empty(approx_rows, dtype=np.float64)
+    nnz = np.empty(approx_rows, dtype=np.int64)
+    mx = ctypes.c_int64(-1)
+    n = lib.libsvm_scan(raw, len(raw), _dptr(labels),
+                        nnz.ctypes.data_as(ctypes.POINTER(ctypes.c_int64)),
+                        approx_rows, ctypes.byref(mx))
+    if n <= 0:
+        return None
+    nf = max(int(mx.value) + 1, num_features_hint)
+    X = np.zeros((n, nf), dtype=np.float64)
+    lib.libsvm_fill(raw, len(raw), n, nf, _dptr(X))
+    return X, labels[:n].copy()
+
+
+def bin_values(data: np.ndarray, bounds_list, na_bins) -> Optional[np.ndarray]:
+    """Batch value->bin for all numerical columns. bounds_list[j] = ascending
+    upper bounds of feature j's non-NaN bins; na_bins[j] = NaN bin or -1."""
+    lib = get_lib()
+    if lib is None:
+        return None
+    n, f = data.shape
+    data = np.ascontiguousarray(data, dtype=np.float64)
+    off = np.zeros(f + 1, dtype=np.int64)
+    for j, b in enumerate(bounds_list):
+        off[j + 1] = off[j] + len(b)
+    flat = (np.concatenate([np.asarray(b, np.float64) for b in bounds_list])
+            if off[-1] else np.zeros(1))
+    na = np.asarray(na_bins, dtype=np.int32)
+    out = np.empty((n, f), dtype=np.uint8)
+    lib.bin_columns(_dptr(data), n, f, _dptr(flat),
+                    off.ctypes.data_as(ctypes.POINTER(ctypes.c_int64)),
+                    na.ctypes.data_as(ctypes.POINTER(ctypes.c_int32)),
+                    out.ctypes.data_as(ctypes.POINTER(ctypes.c_uint8)))
+    return out

@@ -107,3 +107,43 @@ def test_cli_train_runs_reference_example_config(tmp_path):
           f"valid_data={d}/binary.test", "num_trees=3", "verbosity=-1",
           "metric_freq=0", f"output_model={model}"])
     assert model.exists()
+
+
+def test_native_parser_binner_parity(tmp_path):
+    """native/fastio.cpp (C++ parser + binner) must be bit-identical to the
+    NumPy fallbacks (reference keeps these native too: src/io/parser.cpp,
+    bin.cpp)."""
+    import lightgbm_tpu.native as N
+    if N.get_lib() is None:
+        pytest.skip("no C++ toolchain")
+    rng = np.random.RandomState(30)
+    M = rng.randn(5000, 6)
+    M[rng.rand(5000) < 0.05, 2] = np.nan
+    p = tmp_path / "d.tsv"
+    rows = ["\t".join("na" if np.isnan(v) else f"{v:.6g}" for v in row)
+            for row in np.column_stack([(M[:, 0] > 0).astype(float), M])]
+    p.write_text("\n".join(rows) + "\n")
+
+    pf_native = load_file(str(p))
+    N._tried, N._lib = False, None
+    os.environ["LGBM_TPU_DISABLE_NATIVE"] = "1"
+    try:
+        pf_py = load_file(str(p))
+    finally:
+        del os.environ["LGBM_TPU_DISABLE_NATIVE"]
+        N._tried, N._lib = False, None
+    np.testing.assert_array_equal(np.nan_to_num(pf_native.X, nan=-9e9),
+                                  np.nan_to_num(pf_py.X, nan=-9e9))
+
+    from lightgbm_tpu.binning import bin_data, find_bin_mappers
+    mappers = find_bin_mappers(M, max_bin=31, min_data_in_bin=3,
+                               sample_cnt=5000, categorical=[])
+    b_native = bin_data(M, mappers)
+    os.environ["LGBM_TPU_DISABLE_NATIVE"] = "1"
+    try:
+        N._tried, N._lib = False, None
+        b_py = bin_data(M, mappers)
+    finally:
+        del os.environ["LGBM_TPU_DISABLE_NATIVE"]
+        N._tried, N._lib = False, None
+    np.testing.assert_array_equal(b_native.bins, b_py.bins)
