@@ -127,7 +127,12 @@ class GBDT:
             objective.init(train_set.label, train_set.weight, train_set.group)
 
         # distributed tree learner (reference: tree_learner config + factory,
-        # tree_learner.cpp:13; 'data' -> DataParallelTreeLearner #26)
+        # tree_learner.cpp:13; 'data' -> DataParallelTreeLearner #26).
+        # num_machines > 1 bootstraps jax.distributed first (the reference's
+        # Network::Init + machine-list linkers), so jax.devices() spans hosts
+        if config.num_machines > 1:
+            from ..parallel.mesh import init_distributed
+            init_distributed(config)
         self._dp = (config.tree_learner in ("data", "data_parallel", "voting")
                     and len(jax.devices()) > 1)
         if self._dp:

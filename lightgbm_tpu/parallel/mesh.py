@@ -48,13 +48,42 @@ def pad_rows_to_devices(x: np.ndarray, n_dev: int):
     return x, n
 
 
-def init_distributed(config) -> None:
-    """Multi-host initialization (reference analog: Network::Init + machine list;
-    here a thin wrapper over jax.distributed)."""
-    if config.num_machines > 1 and config.machines:
-        coords = config.machines.split(",")[0]
-        jax.distributed.initialize(
-            coordinator_address=coords,
-            num_processes=config.num_machines)
-        log.info(f"jax.distributed initialized: process {jax.process_index()} "
-                 f"of {jax.process_count()}")
+_DISTRIBUTED_INITIALIZED = False
+
+
+def init_distributed(config) -> bool:
+    """Multi-host bootstrap (reference analog: Network::Init, network.cpp:30 +
+    the machine-list linkers, linkers_socket.cpp:80-224).
+
+    Reference conventions mapped to jax.distributed:
+    - ``machines`` = comma-separated host:port list (reference 'machines'
+      param); the FIRST entry is the coordinator (every process must pass the
+      same list)
+    - ``num_machines`` = process count
+    - the process id comes from ``machine_list_file`` position in the
+      reference; here it must be provided via the standard jax env
+      (JAX_PROCESS_ID) or cluster auto-detection.
+
+    Called automatically by the GBDT trainer when num_machines > 1. Idempotent.
+    Returns True when running multi-process.
+    """
+    global _DISTRIBUTED_INITIALIZED
+    if config.num_machines <= 1:
+        return False
+    if _DISTRIBUTED_INITIALIZED:
+        return True
+    coords = None
+    if config.machines:
+        coords = config.machines.split(",")[0].strip()
+    import os
+    pid = os.environ.get("JAX_PROCESS_ID")
+    kwargs = {"num_processes": config.num_machines}
+    if coords:
+        kwargs["coordinator_address"] = coords
+    if pid is not None:
+        kwargs["process_id"] = int(pid)
+    jax.distributed.initialize(**kwargs)
+    _DISTRIBUTED_INITIALIZED = True
+    log.info(f"jax.distributed initialized: process {jax.process_index()} "
+             f"of {jax.process_count()} ({jax.device_count()} devices)")
+    return True
