@@ -160,10 +160,17 @@ class Dataset:
         self.bundle_meta = None
         if conf.enable_bundle and binned.bins.shape[1] >= 3:
             from .efb import apply_bundles, plan_bundles
+            # monotone-constrained features must keep their own columns: the
+            # bundle candidate plane does not implement direction filtering
+            mc = list(conf.monotone_constraints or [])
+            fm = binned.feature_map
+            excl = [u for u, orig in enumerate(fm)
+                    if int(orig) < len(mc) and mc[int(orig)] != 0] \
+                if any(mc) else []
             meta = plan_bundles(binned.bins, self.mappers,
                                 max_conflict_rate=conf.max_conflict_rate,
                                 sparse_threshold=conf.sparse_threshold,
-                                seed=conf.data_random_seed)
+                                seed=conf.data_random_seed, exclude=excl)
             if meta is not None:
                 self.bundle_meta = meta
                 self._bins_unbundled = binned.bins

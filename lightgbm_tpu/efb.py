@@ -65,7 +65,8 @@ def plan_bundles(bins: np.ndarray, mappers: List[BinMapper],
                  sparse_threshold: float = 0.8,
                  max_bundle_bins: int = 256,
                  sample_cnt: int = 50_000,
-                 seed: int = 0) -> Optional[BundleMeta]:
+                 seed: int = 0,
+                 exclude=()) -> Optional[BundleMeta]:
     """Greedy conflict-bounded bundling plan (FindGroups, dataset.cpp:92).
 
     Returns None when nothing bundles (dense data keeps its identity layout).
@@ -79,9 +80,10 @@ def plan_bundles(bins: np.ndarray, mappers: List[BinMapper],
     default_bin = np.zeros(f, dtype=np.int32)
     nnz = {}
     cand = []
+    excluded = set(exclude)
     for j, m in enumerate(mappers):
         if m.bin_type == BIN_CATEGORICAL or m.missing_type != MISSING_NONE \
-                or m.num_bins < 2:
+                or m.num_bins < 2 or j in excluded:
             continue
         cnt = np.bincount(bins[sample_idx, j], minlength=m.num_bins)
         db = int(cnt.argmax())

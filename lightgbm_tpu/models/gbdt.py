@@ -107,6 +107,11 @@ class GBDT:
             voting_top_k=(config.top_k if config.tree_learner == "voting"
                           else 0),
         )
+        if (config.tree_learner == "voting"
+                and config.grow_policy != "depthwise"):
+            log.warning("tree_learner=voting is only implemented for the "
+                        "depthwise grower; falling back to plain "
+                        "data-parallel histogram exchange")
         self._bundle_dev = None
         if meta is not None:
             from ..ops.split import BundleArrays
@@ -228,16 +233,24 @@ class GBDT:
 
     @staticmethod
     def _monotone_tuple(config, train_set) -> tuple:
-        """Map raw-column monotone constraints to used-feature order
-        (trivial features are dropped at binning, so indices shift)."""
+        """Map raw-column monotone constraints to the GROWER's feature order:
+        used-feature order normally, bundle-column order under EFB (bundled
+        features are excluded from bundling when constrained — see
+        Dataset._construct_inner — so bundle columns are always 0)."""
         mc = list(config.monotone_constraints or [])
         if not any(mc):
             return ()
         fm = train_set.feature_map
         if fm is None:
-            out = mc
+            used = mc
         else:
-            out = [mc[int(orig)] if int(orig) < len(mc) else 0 for orig in fm]
+            used = [mc[int(orig)] if int(orig) < len(mc) else 0 for orig in fm]
+        meta = getattr(train_set, "bundle_meta", None)
+        if meta is not None:
+            out = [used[mem[0][0]] if len(mem) == 1 else 0
+                   for mem in meta.members]
+        else:
+            out = used
         return tuple(int(v) for v in out)
 
     # ---- valid sets (reference: GBDT::AddValidDataset, gbdt.cpp) ----

@@ -13,6 +13,7 @@
 // Built on demand by native/__init__.py with g++ -O3 -shared; every entry
 // point has a NumPy fallback, so the framework works without a toolchain.
 
+#include <atomic>
 #include <cctype>
 #include <cmath>
 #include <cstdint>
@@ -34,6 +35,13 @@ inline bool is_na_token(const char* s, size_t len) {
   return !strcmp(buf, "na") || !strcmp(buf, "nan") || !strcmp(buf, "null") ||
          !strcmp(buf, "none") || !strcmp(buf, "n/a") || !strcmp(buf, "?") ||
          !strcmp(buf, "unknown");
+}
+
+inline const char* find_ws(const char* p, const char* end) {
+  // label/feature separator: space OR tab (the reference and the Python
+  // fallback accept both)
+  while (p < end && *p != ' ' && *p != '\t') ++p;
+  return p < end ? p : nullptr;
 }
 
 inline double parse_token(const char* s, const char* end) {
@@ -152,13 +160,13 @@ int64_t libsvm_scan(const char* buf, int64_t n_bytes, double* labels,
                     int64_t* row_nnz, int64_t cap_rows, int64_t* max_idx) {
   LineIndex idx = index_lines(buf, n_bytes);
   int64_t n = std::min<int64_t>(cap_rows, idx.starts.size());
-  volatile int64_t mx = -1;
+  std::atomic<int64_t> mx{-1};
   parallel_for(n, [&](int64_t lo, int64_t hi) {
     int64_t local_mx = -1;
     for (int64_t i = lo; i < hi; ++i) {
       const char* p = idx.starts[i];
       const char* line_end = idx.ends[i];
-      const char* sp = static_cast<const char*>(memchr(p, ' ', line_end - p));
+      const char* sp = find_ws(p, line_end);
       const char* lab_end = sp ? sp : line_end;
       labels[i] = parse_token(p, lab_end);
       int64_t cnt = 0;
@@ -176,16 +184,14 @@ int64_t libsvm_scan(const char* buf, int64_t n_bytes, double* labels,
       }
       row_nnz[i] = cnt;
     }
-    // benign race: max over threads via CAS-free retry is overkill; use a
-    // simple lock-free max with compare loop
-    int64_t cur = mx;
-    while (local_mx > cur) {
-      mx = local_mx;  // races only lower values momentarily; re-check
-      cur = mx;
-      if (cur >= local_mx) break;
+    // atomic fetch-max (the previous volatile retry loop could lose updates)
+    int64_t cur = mx.load(std::memory_order_relaxed);
+    while (local_mx > cur &&
+           !mx.compare_exchange_weak(cur, local_mx,
+                                     std::memory_order_relaxed)) {
     }
   });
-  *max_idx = mx;
+  *max_idx = mx.load();
   return n;
 }
 
@@ -198,7 +204,7 @@ int32_t libsvm_fill(const char* buf, int64_t n_bytes, int64_t n_rows,
     for (int64_t i = lo; i < hi; ++i) {
       const char* p = idx.starts[i];
       const char* line_end = idx.ends[i];
-      const char* sp = static_cast<const char*>(memchr(p, ' ', line_end - p));
+      const char* sp = find_ws(p, line_end);
       double* row = out + i * n_cols;
       p = sp ? sp + 1 : line_end;
       while (p < line_end) {

@@ -359,7 +359,13 @@ def best_split(hist: jnp.ndarray, num_bins: jnp.ndarray, na_bin: jnp.ndarray,
                & bundle.valid[None, :, :] & bundle.is_bundle[None, :, None]
                & feature_mask[None, :, None])
         if p.has_monotone:
-            gainB = leaf_split_gain(lgB, lhB, p) + leaf_split_gain(rgB, rhB, p)
+            # bundled features are never themselves monotone-constrained
+            # (Dataset excludes them from bundling), but the LEAF's output
+            # bounds still apply to any split of a constrained leaf
+            wlB = jnp.clip(leaf_output(lgB, lhB, p), lmin, lmax)
+            wrB = jnp.clip(leaf_output(rgB, rhB, p), lmin, lmax)
+            gainB = (leaf_gain_given_output(lgB, lhB, wlB, p)
+                     + leaf_gain_given_output(rgB, rhB, wrB, p))
         else:
             gainB = leaf_split_gain(lgB, lhB, p) + leaf_split_gain(rgB, rhB, p)
         gainB = jnp.where(okB, gainB, NEG_INF)

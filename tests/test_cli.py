@@ -147,3 +147,23 @@ def test_native_parser_binner_parity(tmp_path):
         del os.environ["LGBM_TPU_DISABLE_NATIVE"]
         N._tried, N._lib = False, None
     np.testing.assert_array_equal(b_native.bins, b_py.bins)
+
+
+def test_native_libsvm_tabs(tmp_path):
+    """Tab-separated LibSVM parses identically in native and fallback paths
+    (review finding: the native parser only split on spaces)."""
+    p = tmp_path / "d.libsvm"
+    p.write_text("1\t2:3.5\t7:1.25\n0\t0:1.0\t5:2.5\n1 1:0.5 7:9.0\n")
+    import lightgbm_tpu.native as N
+    pf_native = load_file(str(p))
+    os.environ["LGBM_TPU_DISABLE_NATIVE"] = "1"
+    try:
+        N._tried, N._lib = False, None
+        pf_py = load_file(str(p))
+    finally:
+        del os.environ["LGBM_TPU_DISABLE_NATIVE"]
+        N._tried, N._lib = False, None
+    np.testing.assert_array_equal(pf_native.label, pf_py.label)
+    np.testing.assert_array_equal(pf_native.X, pf_py.X)
+    assert pf_native.X.shape == (3, 8)
+    assert pf_native.X[0, 2] == 3.5 and pf_native.X[0, 7] == 1.25

@@ -73,3 +73,40 @@ def test_monotone_still_learns():
     pred = np.asarray(bst.predict(X))
     resid = y - pred
     assert np.var(resid) < 0.7 * np.var(y)
+
+
+def test_monotone_with_efb_sparse_data():
+    """Monotone-constrained features must keep their own columns under EFB
+    and stay monotone (review finding: constraints were misaligned with the
+    bundle-column feature order)."""
+    rng = np.random.RandomState(5)
+    n = 1500
+    # sparse one-hot-ish filler features that WILL bundle + one dense
+    # constrained feature
+    mono_f = rng.rand(n)
+    sparse = np.zeros((n, 8))
+    lvl = rng.randint(0, 8, n)
+    sparse[np.arange(n), lvl] = rng.rand(n) + 0.5
+    X = np.column_stack([mono_f, sparse])
+    y = 2 * mono_f + 0.3 * (lvl % 3) + rng.randn(n) * 0.5
+    mc = [1] + [0] * 8
+    ds = lgb.Dataset(X, label=y, params={"max_bin": 31})
+    bst = lgb.train({**_P, "objective": "regression", "max_bin": 31,
+                     "monotone_constraints": mc, "enable_bundle": True},
+                    ds, num_boost_round=25)
+    assert bst.train_set.bundle_meta is not None, "EFB should activate"
+    # the constrained feature is a single (unbundled) column
+    meta = bst.train_set.bundle_meta
+    fm = bst.train_set.feature_map
+    orig_of_used = {u: int(o) for u, o in enumerate(fm)}
+    for mem in meta.members:
+        if len(mem) > 1:
+            assert all(orig_of_used[j] != 0 for j, _, _ in mem)
+    # monotonicity holds in the constrained feature
+    grid = np.linspace(0.01, 0.99, 40)
+    for _ in range(10):
+        base = X[rng.randint(0, n)].copy()
+        rows = np.tile(base, (40, 1))
+        rows[:, 0] = grid
+        pred = np.asarray(bst.predict(rows))
+        assert (np.diff(pred) >= -1e-9).all()
