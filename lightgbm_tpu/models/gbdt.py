@@ -140,6 +140,15 @@ class GBDT:
             init_distributed(config)
         self._dp = (config.tree_learner in ("data", "data_parallel", "voting")
                     and len(jax.devices()) > 1)
+        # feature-parallel (#25): full data replicated, features sharded,
+        # split election via compiler-inserted collectives
+        self._fp = (config.tree_learner in ("feature", "feature_parallel")
+                    and len(jax.devices()) > 1)
+        if self._fp:
+            from ..parallel.feature_parallel import make_feature_mesh
+            self._fmesh = make_feature_mesh()
+            log.info(f"feature-parallel tree learner over "
+                     f"{self._fmesh.devices.size} devices")
         if self._dp:
             from ..parallel.mesh import make_mesh, pad_rows_to_devices, shard_rows
             self._mesh = make_mesh()
@@ -417,7 +426,7 @@ class GBDT:
 
     def _grow_and_update(self, grad, hess) -> bool:
         k = self.num_tree_per_iteration
-        if self._supports_fused and not self._dp and k <= 8:
+        if self._supports_fused and not self._dp and not self._fp and k <= 8:
             trees, new_score = self._fused_step(grad, hess)
             bias_active = self.iter_ == 0 and any(
                 abs(b) > K_EPSILON for b in self.init_scores)
@@ -487,7 +496,12 @@ class GBDT:
             h = hess if k == 1 else hess[:, cls]
             gw, hw, cw = self._make_ghc(g, h)
             depthwise = self.config.grow_policy == "depthwise"
-            if self._dp:
+            if self._fp:
+                from ..parallel.feature_parallel import grow_tree_fp
+                tree_dev, leaf_id = grow_tree_fp(
+                    ts.bins, gw, hw, cw, ts.num_bins_dev, ts.na_bin_dev,
+                    fmask, self.gp, self._fmesh, bundle=self._bundle_dev)
+            elif self._dp:
                 from ..parallel.data_parallel import grow_tree_dp
                 from ..parallel.mesh import shard_rows
                 if self._pad_rows:

@@ -91,3 +91,19 @@ def test_depthwise_serial_and_dp():
     s = b1.model_to_string()
     b3 = lgb.Booster(model_str=s)
     np.testing.assert_allclose(b1.predict(X), b3.predict(X), rtol=1e-5, atol=1e-6)
+
+
+def test_feature_parallel_equals_serial():
+    """Feature-parallel (#25: features sharded, data replicated, split
+    election via SPMD-inserted collectives) must equal serial training."""
+    X, y = make_classification(n_samples=900, n_features=16, random_state=4)
+    p = {"objective": "binary", "num_leaves": 15, "verbosity": -1,
+         "min_data_in_leaf": 5, "histogram_impl": "scatter"}
+    b1 = lgb.train(p, lgb.Dataset(X, label=y), num_boost_round=8)
+    b2 = lgb.train({**p, "tree_learner": "feature"}, lgb.Dataset(X, label=y),
+                   num_boost_round=8)
+    np.testing.assert_allclose(np.asarray(b1.predict(X)),
+                               np.asarray(b2.predict(X)),
+                               rtol=1e-4, atol=1e-5)
+    from sklearn.metrics import roc_auc_score as _auc
+    assert _auc(y, b2.predict(X)) > 0.9
