@@ -53,6 +53,22 @@ def grow_tree_fp(bins, g, h, c, num_bins, na_bin, feature_mask,
             gp, hist_impl="scatter" if jax.default_backend() == "cpu"
             else "onehot")
 
+    # pad the feature axis to a multiple of the mesh size with dead features
+    # (1 bin, masked out) — they can never win a split
+    import jax.numpy as jnp
+    nd = int(mesh.devices.size)
+    f = bins.shape[1]
+    pad = (-f) % nd
+    if pad:
+        bins = jnp.pad(bins, ((0, 0), (0, pad)))
+        num_bins = jnp.pad(num_bins, (0, pad), constant_values=1)
+        na_bin = jnp.pad(na_bin, (0, pad), constant_values=256)
+        feature_mask = jnp.pad(feature_mask, (0, pad), constant_values=False)
+        if bundle is not None:
+            bundle = type(bundle)(*[
+                jnp.pad(a, ((0, pad),) + ((0, 0),) * (a.ndim - 1))
+                for a in bundle])
+
     col = NamedSharding(mesh, P(None, FEATURE_AXIS))
     vec = NamedSharding(mesh, P(FEATURE_AXIS))
     rep = NamedSharding(mesh, P())
