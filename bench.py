@@ -1,11 +1,16 @@
 """Benchmark: boosting iterations/sec on a HIGGS-shaped synthetic dataset.
 
 Baseline (BASELINE.md): reference CPU trains HIGGS (10.5M rows x 28 features,
-num_leaves=255, 500 iters) in 238.5 s on 2x E5-2670v3 => 2.096 iters/sec.
-GPU parity experiments use max_bin=63 (docs/GPU-Performance.rst:43-45), which we
-adopt for the TPU histogram kernels.
+num_leaves=255, 500 iters) in 238.5 s on 2x E5-2670v3 => 2.096 iters/sec at
+10.5M rows. GPU parity experiments use max_bin=63 (docs/GPU-Performance.rst:43-45),
+which we adopt for the TPU histogram kernels.
 
-Prints ONE JSON line: {"metric", "value", "unit", "vs_baseline"}.
+The default run is the baseline's own scale (10M rows) and ``vs_baseline``
+compares equal row counts: per-iteration cost is linear in rows (the histogram
+pass is O(N)), so the baseline rate at N rows is 2.096 * 10.5e6 / N. (Round-2
+VERDICT weak #1: the old bench divided a 1M-row rate by the 10.5M-row baseline.)
+
+Prints ONE JSON line: {"metric", "value", "unit", "vs_baseline", "bin_s", ...}.
 
 Env overrides: LGBM_TPU_BENCH_ROWS, LGBM_TPU_BENCH_ITERS, LGBM_TPU_BENCH_LEAVES.
 """
@@ -16,7 +21,8 @@ import time
 
 import numpy as np
 
-BASELINE_ITERS_PER_SEC = 500.0 / 238.5
+BASELINE_ROWS = 10_500_000
+BASELINE_ITERS_PER_SEC = 500.0 / 238.5   # at BASELINE_ROWS
 
 
 def synth_higgs(n_rows: int, n_feat: int = 28, seed: int = 0):
@@ -33,7 +39,7 @@ def synth_higgs(n_rows: int, n_feat: int = 28, seed: int = 0):
 
 
 def main():
-    n_rows = int(os.environ.get("LGBM_TPU_BENCH_ROWS", 1_000_000))
+    n_rows = int(os.environ.get("LGBM_TPU_BENCH_ROWS", 10_000_000))
     n_iters = int(os.environ.get("LGBM_TPU_BENCH_ITERS", 20))
     num_leaves = int(os.environ.get("LGBM_TPU_BENCH_LEAVES", 255))
     max_bin = int(os.environ.get("LGBM_TPU_BENCH_BINS", 63))
@@ -82,11 +88,16 @@ def main():
     if n_rows >= 500_000 and n_iters >= 20:
         assert auc > 0.75, f"train AUC {auc:.4f} below sanity floor 0.75"
 
+    # honest same-scale comparison: baseline rate scaled to the benched rows
+    baseline_here = BASELINE_ITERS_PER_SEC * BASELINE_ROWS / n_rows
     result = {
-        "metric": "boosting_iters_per_sec_higgs1m_l255_b63",
+        "metric": f"boosting_iters_per_sec_higgs{n_rows // 1_000_000}m_l255_b63",
         "value": round(iters_per_sec, 4),
         "unit": "iters/sec",
-        "vs_baseline": round(iters_per_sec / BASELINE_ITERS_PER_SEC, 4),
+        "vs_baseline": round(iters_per_sec / baseline_here, 4),
+        "bin_s": round(t_bin, 2),
+        "compile_s": round(t_compile, 2),
+        "train_auc": round(auc, 4),
     }
     print(json.dumps(result))
     print(f"# rows={n_rows} iters={n_iters} leaves={num_leaves} bins={max_bin} "

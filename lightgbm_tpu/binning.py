@@ -176,17 +176,23 @@ class BinMapper:
         else:
             # equal-frequency greedy: walk distinct values accumulating counts until
             # the per-bin budget is met (reference: GreedyFindBin in src/io/bin.cpp —
-            # ours is a fresh weighted-quantile formulation, not a translation)
+            # ours is a fresh weighted-quantile formulation, not a translation).
+            # The walk is O(#bins) searchsorteds over the cumulative counts, not a
+            # Python loop over up to 200k distinct values (~100 ms/feature, the
+            # round-2 dataset_construct regression).
             total = counts.sum()
             n_bins = max(1, min(budget, int(total // max(1, min_data_in_bin)) or 1))
             target = total / n_bins
+            cum = np.cumsum(counts, dtype=np.float64)
             bounds_list: List[float] = []
-            acc = 0.0
-            for i in range(len(distinct) - 1):
-                acc += counts[i]
-                if acc >= target - 1e-9 and len(bounds_list) < n_bins - 1:
-                    bounds_list.append((distinct[i] + distinct[i + 1]) / 2.0)
-                    acc = 0.0
+            base = 0.0
+            last = len(distinct) - 1   # the last distinct value never emits
+            for _ in range(n_bins - 1):
+                i = int(np.searchsorted(cum, base + target - 1e-9, side="left"))
+                if i >= last:
+                    break
+                bounds_list.append((distinct[i] + distinct[i + 1]) / 2.0)
+                base = cum[i]
             bounds = np.unique(np.array(bounds_list + [np.inf]))
             if zero_cnt > 0:
                 bounds = BinMapper._fix_zero_boundary(bounds, distinct)
@@ -400,12 +406,21 @@ def bin_data(
                 na_list.append(m.num_bins - 1)
             else:  # NaN coerced to the bin holding 0.0
                 na_list.append(int(m.values_to_bins(np.asarray([0.0]))[0]))
-        sub = np.ascontiguousarray(data[:, [j for _, j in num_cols]])
+        sel = [j for _, j in num_cols]
+        if sel == list(range(f)) and data.flags.c_contiguous:
+            sub = data  # all-numeric dense case: no 2x host copy
+        else:
+            sub = np.ascontiguousarray(data[:, sel])
         res = native_bin_values(sub, bounds_list, na_list)
         if res is not None:
-            for idx, (k, j) in enumerate(num_cols):
-                out[:, k] = res[:, idx]
-                done.add(k)
+            if len(num_cols) == len(used) and \
+                    all(k == idx for idx, (k, _) in enumerate(num_cols)):
+                out = res   # all columns numeric: skip the 280MB re-copy
+                done = set(range(len(used)))
+            else:
+                for idx, (k, j) in enumerate(num_cols):
+                    out[:, k] = res[:, idx]
+                    done.add(k)
     for k, j in enumerate(used):
         if k in done:
             continue

@@ -131,7 +131,10 @@ int32_t csv_parse(const char* buf, int64_t n_bytes, char delim,
   int64_t offset = skip_first ? 1 : 0;
   if (static_cast<int64_t>(idx.starts.size()) - offset < n_rows) return -2;
   *bad_row = -1;
-  volatile int32_t status = 0;
+  // atomics: status/bad_row are written from every worker thread (same bug
+  // class as the libsvm_scan fetch-max race fixed earlier)
+  std::atomic<int32_t> status{0};
+  std::atomic<int64_t> bad{-1};
   parallel_for(n_rows, [&](int64_t lo, int64_t hi) {
     for (int64_t i = lo; i < hi; ++i) {
       const char* p = idx.starts[i + offset];
@@ -147,12 +150,13 @@ int32_t csv_parse(const char* buf, int64_t n_bytes, char delim,
         p = tok_end + 1;
       }
       if (c != n_cols) {
-        status = -1;
-        *bad_row = i;
+        status.store(-1, std::memory_order_relaxed);
+        bad.store(i, std::memory_order_relaxed);
       }
     }
   });
-  return status;
+  *bad_row = bad.load();
+  return status.load();
 }
 
 // LibSVM pass 1: per-row nonzero counts, max feature index, labels.
@@ -224,35 +228,70 @@ int32_t libsvm_fill(const char* buf, int64_t n_bytes, int64_t n_rows,
   return 0;
 }
 
-// Batch value->bin over all columns (BinMapper::ValueToBin, bin.cpp).
-// data: [N, F] row-major f64. For feature j: binary-search its bounds
-// (bounds_flat[bounds_off[j] .. bounds_off[j+1]) = ascending upper bounds of
-// the non-NaN bins); NaN -> na_bin[j] (if >= 0 else bin of 0.0).
-void bin_columns(const double* data, int64_t n, int64_t f,
-                 const double* bounds_flat, const int64_t* bounds_off,
-                 const int32_t* na_bin, uint8_t* out) {
+}  // extern "C"
+
+namespace {
+
+// Value->bin for one value: bins are (prev, bound] intervals; the answer is
+// the count of bounds strictly below v, capped at nb-1. For the common
+// max_bin<=64 case a branchless linear scan beats binary search: it
+// auto-vectorizes (no data-dependent branches to mispredict) — this is the
+// hot loop of dataset construction on a 1-core host.
+inline int64_t value_to_bin(double v, const double* b, int64_t nb) {
+  if (nb <= 64) {
+    int64_t cnt = 0;
+    for (int64_t k = 0; k < nb - 1; ++k) cnt += (v > b[k]);
+    return cnt;
+  }
+  int64_t lo_i = 0, hi_i = nb - 1;
+  while (lo_i < hi_i) {
+    int64_t mid = (lo_i + hi_i) >> 1;
+    if (v <= b[mid]) hi_i = mid; else lo_i = mid + 1;
+  }
+  return lo_i;
+}
+
+template <typename T>
+void bin_columns_impl(const T* data, int64_t n, int64_t f,
+                      const double* bounds_flat, const int64_t* bounds_off,
+                      const int32_t* na_bin, uint8_t* out) {
   parallel_for(n, [&](int64_t lo, int64_t hi) {
     for (int64_t i = lo; i < hi; ++i) {
-      const double* row = data + i * f;
+      const T* row = data + i * f;
       uint8_t* orow = out + i * f;
       for (int64_t j = 0; j < f; ++j) {
-        double v = row[j];
-        const double* b = bounds_flat + bounds_off[j];
-        int64_t nb = bounds_off[j + 1] - bounds_off[j];
+        // f32 inputs upcast in-register: comparisons against the f64 bounds
+        // are exact, so f32 ingestion loses nothing vs a host-side f64 copy
+        double v = static_cast<double>(row[j]);
         if (std::isnan(v)) {
           orow[j] = static_cast<uint8_t>(na_bin[j] >= 0 ? na_bin[j] : 0);
           continue;
         }
-        // upper_bound: first bound >= v (bins are (prev, bound] intervals)
-        int64_t lo_i = 0, hi_i = nb - 1;
-        while (lo_i < hi_i) {
-          int64_t mid = (lo_i + hi_i) >> 1;
-          if (v <= b[mid]) hi_i = mid; else lo_i = mid + 1;
-        }
-        orow[j] = static_cast<uint8_t>(lo_i);
+        orow[j] = static_cast<uint8_t>(value_to_bin(
+            v, bounds_flat + bounds_off[j], bounds_off[j + 1] - bounds_off[j]));
       }
     }
   });
+}
+
+}  // namespace
+
+extern "C" {
+
+// Batch value->bin over all columns (BinMapper::ValueToBin, bin.cpp).
+// data: [N, F] row-major f64 (or f32 via the _f32 variant). For feature j:
+// bounds_flat[bounds_off[j] .. bounds_off[j+1]) = ascending upper bounds of
+// the non-NaN bins; NaN -> na_bin[j] (if >= 0 else bin of 0.0).
+void bin_columns(const double* data, int64_t n, int64_t f,
+                 const double* bounds_flat, const int64_t* bounds_off,
+                 const int32_t* na_bin, uint8_t* out) {
+  bin_columns_impl(data, n, f, bounds_flat, bounds_off, na_bin, out);
+}
+
+void bin_columns_f32(const float* data, int64_t n, int64_t f,
+                     const double* bounds_flat, const int64_t* bounds_off,
+                     const int32_t* na_bin, uint8_t* out) {
+  bin_columns_impl(data, n, f, bounds_flat, bounds_off, na_bin, out);
 }
 
 }  // extern "C"
