@@ -90,8 +90,10 @@ def main():
 
     # honest same-scale comparison: baseline rate scaled to the benched rows
     baseline_here = BASELINE_ITERS_PER_SEC * BASELINE_ROWS / n_rows
+    rows_tag = (f"{n_rows // 1_000_000}m" if n_rows % 1_000_000 == 0
+                else f"{n_rows // 1000}k")
     result = {
-        "metric": f"boosting_iters_per_sec_higgs{n_rows // 1_000_000}m_l255_b63",
+        "metric": f"boosting_iters_per_sec_higgs{rows_tag}_l255_b63",
         "value": round(iters_per_sec, 4),
         "unit": "iters/sec",
         "vs_baseline": round(iters_per_sec / baseline_here, 4),

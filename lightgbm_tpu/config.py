@@ -144,7 +144,12 @@ _PARAMS: Dict[str, Tuple[Any, Tuple[str, ...]]] = {
     "gpu_device_id": (-1, ()),
     "gpu_use_dp": (False, ()),
     # ---- TPU-specific (new in this framework) ----
-    "histogram_impl": ("auto", ()),        # auto | onehot | scatter
+    "histogram_impl": ("auto", ()),        # auto | onehot | scatter | pallas
+    # int8 quantized-gradient histograms (LightGBM 4.x use_quantized_grad
+    # analog): "auto" enables it on the TPU pallas path (3 int8 MXU channels
+    # instead of 5 bf16 — ~3.3x on the dominant contraction; leaf values are
+    # renewed from exact sums), "true"/"false" force it
+    "use_quantized_grad": ("auto", ()),
     # depthwise is the TPU default: O(depth) histogram passes per tree instead of
     # O(num_leaves) (the reference's leaf-wise semantics are available via
     # grow_policy=lossguide; tree quality is near-identical because depthwise

@@ -86,10 +86,23 @@ class GBDT:
         else:
             cat_feats = tuple(i for i, m in enumerate(train_set.mappers)
                               if m.bin_type == BIN_CATEGORICAL)
+        # int8 quantized-gradient histograms (config use_quantized_grad):
+        # auto = on for the depthwise pallas path (i.e. on TPU)
+        from ..ops.histogram import pick_impl as _pick_impl
+        uq = str(config.use_quantized_grad).lower()
+        quant_on = (uq in ("true", "1")) or (
+            uq == "auto" and _pick_impl(config.histogram_impl) == "pallas")
+        if quant_on and config.grow_policy != "depthwise":
+            if uq in ("true", "1"):
+                log.warning("use_quantized_grad only applies to the depthwise "
+                            "grower; ignoring for grow_policy="
+                            f"{config.grow_policy}")
+            quant_on = False
         self.gp = GrowParams(
             num_leaves=config.num_leaves,
             max_depth=config.max_depth,
             max_bin=B,
+            quant=quant_on,
             split=SplitParams(
                 lambda_l1=config.lambda_l1, lambda_l2=config.lambda_l2,
                 min_gain_to_split=config.min_gain_to_split,
@@ -361,7 +374,7 @@ class GBDT:
         depthwise_fused = self.config.grow_policy == "depthwise"
 
         def step(bins, num_bins, na_bin, score, fmask, bag_mask, grad, hess,
-                 shrink):
+                 shrink, qseed):
             if not custom:
                 grad, hess = obj.get_gradients(score)
             trees = []
@@ -371,6 +384,8 @@ class GBDT:
                 h = hess if k == 1 else hess[:, cls]
                 kw = {"forced": forced} if (depthwise_fused and
                                              forced is not None) else {}
+                if depthwise_fused and gp.quant:
+                    kw["qseed"] = qseed * k + cls
                 tree, leaf_id = grow_fn(bins, g * bag_mask, h * bag_mask,
                                         (bag_mask > 0).astype(g.dtype),
                                         num_bins, na_bin, fmask, gp,
@@ -415,7 +430,7 @@ class GBDT:
                               self.train_score, self._feature_mask(), bag,
                               grad if custom else dummy,
                               hess if custom else dummy,
-                              jnp.float32(shrink))
+                              jnp.float32(shrink), jnp.int32(self.iter_))
         return trees, new_score
 
     def _grow_fn(self):
@@ -516,14 +531,17 @@ class GBDT:
                 tree_dev, leaf_id = grow_tree_dp(
                     self._bins_dp, gw, hw, cw, ts.num_bins_dev, ts.na_bin_dev,
                     fmask, self.gp, self._mesh, grow_fn=grow_fn,
-                    bundle=self._bundle_dev)
+                    bundle=self._bundle_dev,
+                    qseed=jnp.int32(self.iter_ * k + cls))
                 leaf_id = leaf_id[: self._n_orig]
             elif depthwise:
                 from ..ops.grow_depthwise import grow_tree_depthwise
+                qkw = ({"qseed": jnp.int32(self.iter_ * k + cls)}
+                       if self.gp.quant else {})
                 tree_dev, leaf_id = grow_tree_depthwise(
                     ts.bins, gw, hw, cw, ts.num_bins_dev, ts.na_bin_dev,
                     fmask, self.gp, bundle=self._bundle_dev,
-                    forced=self._forced_dev)
+                    forced=self._forced_dev, **qkw)
             else:
                 tree_dev, leaf_id = grow_tree(ts.bins, gw, hw, cw,
                                               ts.num_bins_dev, ts.na_bin_dev,

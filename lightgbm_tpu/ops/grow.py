@@ -35,6 +35,9 @@ class GrowParams:
     max_bin: int = 255            # padded bin axis length B
     split: SplitParams = SplitParams()
     hist_impl: str = "auto"
+    # int8 quantized-gradient histograms (LightGBM 4.x technique; applies to
+    # the depthwise/pallas path — leaf values are renewed from exact sums)
+    quant: bool = False
     # voting-parallel: top-k features elected per level for histogram exchange
     # (reference: VotingParallelTreeLearner, top_k config); 0 = off
     voting_top_k: int = 0

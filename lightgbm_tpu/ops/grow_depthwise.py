@@ -76,13 +76,15 @@ def _scatter_set(arr, idx, val, mask):
 def grow_tree_depthwise(bins: jnp.ndarray, g: jnp.ndarray, h: jnp.ndarray,
                         c: jnp.ndarray, num_bins: jnp.ndarray,
                         na_bin: jnp.ndarray, feature_mask: jnp.ndarray,
-                        gp: GrowParams, bundle=None, forced=None
+                        gp: GrowParams, bundle=None, forced=None, qseed=None
                         ) -> Tuple[TreeArrays, jnp.ndarray]:
     """Grow one tree level-wise.
 
     bins: [N, F] uint8; g/h/c: [N] f32 grad/hess/in-bag count channels (already
     masked). Under shard_map with gp.axis_name set, histograms are psum-reduced
-    (data-parallel). Returns (TreeArrays, leaf_id [N] i32).
+    (data-parallel). ``qseed`` (traced i32, e.g. the iteration index) varies
+    the stochastic-rounding dither when gp.quant is on. Returns
+    (TreeArrays, leaf_id [N] i32).
     """
     n, f = bins.shape
     L, B = gp.num_leaves, gp.max_bin
@@ -95,7 +97,12 @@ def grow_tree_depthwise(bins: jnp.ndarray, g: jnp.ndarray, h: jnp.ndarray,
     # pallas kernels read a transposed bin matrix; build it ONCE per tree (XLA
     # CSEs it across all level passes inside this jit)
     bins_T = bins.T if H.pick_impl(gp.hist_impl) == "pallas" else None
-    hist0 = _psum(H.hist_leaf(bins, g, h, c, B, gp.hist_impl, bins_T=bins_T),
+    # int8 quantized channels, built once per tree; per-shard scales are fine
+    # under data-parallel because every histogram is dequantized to f32 before
+    # the psum (each shard contributes real-valued mass)
+    quant = H.make_quant(g, h, c, qseed) if gp.quant else None
+    hist0 = _psum(H.hist_leaf(bins, g, h, c, B, gp.hist_impl, bins_T=bins_T,
+                              quant=quant),
                   gp)                                                # [3, F, B]
     g0 = hist0[0, 0].sum()
     h0 = hist0[1, 0].sum()
@@ -259,7 +266,7 @@ def grow_tree_depthwise(bins: jnp.ndarray, g: jnp.ndarray, h: jnp.ndarray,
         )
         hist_pass, leaf_id2 = H.hist_routed(
             bins, g, h, c, st.leaf_id, tables, na_bin, S_pass, B, gp.hist_impl,
-            bins_T=bins_T)
+            bins_T=bins_T, quant=quant)
         if voting:
             # ---- voting-parallel histogram exchange (PV-Tree; reference:
             # VotingParallelTreeLearner GlobalVoting + CopyLocalHistogram,
@@ -410,4 +417,25 @@ def grow_tree_depthwise(bins: jnp.ndarray, g: jnp.ndarray, h: jnp.ndarray,
 
         state, _, _ = jax.lax.while_loop(
             cond, body, (state, jnp.int32(n_unroll), last_sel))
+
+    if gp.quant:
+        # leaf renewal from EXACT sums (quantized-training paper: splits
+        # tolerate int8 gains, leaf outputs should not; reference analog:
+        # exact LeafSplits aggregates, leaf_splits.hpp:20)
+        from .pallas_hist import leaf_sums_pallas
+        # interpret only where Mosaic can't compile (CPU backend) — keying on
+        # hist_impl would run the interpreter inside the jitted tree on TPU
+        interp = jax.default_backend() == "cpu"
+        sums = _psum(leaf_sums_pallas(g, h, c, state.leaf_id, L,
+                                      interpret=interp), gp)
+        eg, eh, ec = sums[0], sums[1], sums[2]
+        w = leaf_output(eg, eh, sp)
+        if sp.has_monotone:
+            w = jnp.clip(w, state.leaf_min, state.leaf_max)
+        tr = state.tree
+        live = jnp.arange(L) < tr.num_leaves
+        state = state._replace(tree=tr._replace(
+            leaf_value=jnp.where(live, w, tr.leaf_value),
+            leaf_weight=jnp.where(live, eh, tr.leaf_weight),
+            leaf_count=jnp.where(live, ec, tr.leaf_count)))
     return state.tree, state.leaf_id

@@ -338,6 +338,45 @@ def hist_routed_scatter(bins, g, h, c, leaf_id, tables: RouteTables, na_bin,
 
 
 # ---------------------------------------------------------------------------
+# int8 gradient quantization (LightGBM 4.x "quantized training" analog)
+# ---------------------------------------------------------------------------
+
+def quantize_sr(x: jnp.ndarray, seed, salt: int):
+    """Stochastic-rounding int8 quantization: returns (q [N] int8, scale f32).
+
+    E[q] = x * 127 / scale (unbiased — round-to-nearest systematically biases
+    split gains at low bit widths; the quantized-training paper uses
+    stochastic rounding for the same reason). The dither is a counter-based
+    hash of (row index, seed, salt) — no threaded PRNG key, so the jitted
+    tree build stays a pure function of its operands."""
+    n = x.shape[0]
+    i = jnp.arange(n, dtype=jnp.uint32) + jnp.uint32((salt * 0x632BE59B) & 0xFFFFFFFF)
+    k = jnp.uint32(0) if seed is None else jnp.asarray(seed).astype(jnp.uint32)
+    z = (i ^ (k * jnp.uint32(0x9E3779B9))) * jnp.uint32(2654435761)
+    z = (z ^ (z >> 15)) * jnp.uint32(2246822519)
+    z = z ^ (z >> 13)
+    u = (z >> 8).astype(jnp.float32) * jnp.float32(1.0 / (1 << 24))
+    scale = jnp.maximum(jnp.max(jnp.abs(x)), 1e-20).astype(jnp.float32)
+    q = jnp.floor(x * (127.0 / scale) + u)
+    return jnp.clip(q, -127, 127).astype(jnp.int8), scale
+
+
+class QuantChannels(NamedTuple):
+    """Per-tree quantized row channels + scales (built once per tree)."""
+    gq: jnp.ndarray      # [N] int8
+    hq: jnp.ndarray      # [N] int8
+    cq: jnp.ndarray      # [N] int8 0/1
+    scale_g: jnp.ndarray  # f32 scalar
+    scale_h: jnp.ndarray  # f32 scalar
+
+
+def make_quant(g, h, c, seed) -> QuantChannels:
+    gq, sg = quantize_sr(g, seed, salt=1)
+    hq, sh = quantize_sr(h, seed, salt=2)
+    return QuantChannels(gq, hq, c.astype(jnp.int8), sg, sh)
+
+
+# ---------------------------------------------------------------------------
 # dispatch
 # ---------------------------------------------------------------------------
 
@@ -351,8 +390,20 @@ def pick_impl(requested: str, backend: Optional[str] = None) -> str:
     return "scatter" if backend == "cpu" else "pallas"
 
 
-def hist_leaf(bins, g, h, c, num_bins, impl="auto", bins_T=None):
+def hist_leaf(bins, g, h, c, num_bins, impl="auto", bins_T=None, quant=None):
     impl = pick_impl(impl)
+    if quant is not None and impl == "pallas":
+        from .pallas_hist import hist_pallas_q8
+        bt = bins_T if bins_T is not None else bins.T
+        slot = jnp.zeros(bins.shape[0], jnp.int32)
+        return hist_pallas_q8(bt, quant.gq, quant.hq, quant.cq, slot, 1,
+                              num_bins, quant.scale_g, quant.scale_h)[0]
+    if quant is not None:
+        # non-pallas backends: dequantize per row (same numbers the int32
+        # accumulator would produce, up to f32 summation order)
+        g = quant.gq.astype(jnp.float32) * (quant.scale_g / 127.0)
+        h = quant.hq.astype(jnp.float32) * (quant.scale_h / 127.0)
+        c = quant.cq.astype(jnp.float32)
     if impl == "scatter":
         return hist_leaf_scatter(bins, g, h, c, num_bins)
     if impl == "pallas":
@@ -375,13 +426,18 @@ def hist_per_leaf(bins, g, h, c, leaf_id, num_leaves, num_bins, impl="auto",
 
 
 def hist_routed(bins, g, h, c, leaf_id, tables, na_bin, num_slots, num_bins,
-                impl="auto", bins_T=None):
+                impl="auto", bins_T=None, quant=None):
     impl = pick_impl(impl)
+    if quant is not None and impl != "pallas":
+        g = quant.gq.astype(jnp.float32) * (quant.scale_g / 127.0)
+        h = quant.hq.astype(jnp.float32) * (quant.scale_h / 127.0)
+        c = quant.cq.astype(jnp.float32)
     if impl == "scatter":
         return hist_routed_scatter(bins, g, h, c, leaf_id, tables, na_bin,
                                    num_slots, num_bins)
     if impl == "pallas":
-        from .pallas_hist import hist_pallas, route_level_pallas
+        from .pallas_hist import (hist_pallas, hist_pallas_q8,
+                                  route_level_pallas)
         bt = bins_T if bins_T is not None else bins.T
         if bins.shape[1] <= 512:
             slot, lid2 = route_level_pallas(bt, leaf_id, tables, na_bin,
@@ -391,6 +447,10 @@ def hist_routed(bins, g, h, c, leaf_id, tables, na_bin, num_slots, num_bins,
             # VMEM; fall back to the XLA gather route (EFB bundling keeps
             # training-width under this cap for sparse-wide datasets)
             slot, lid2 = route_level(bins, leaf_id, tables, na_bin, num_slots)
+        if quant is not None:
+            return hist_pallas_q8(bt, quant.gq, quant.hq, quant.cq, slot,
+                                  num_slots, num_bins, quant.scale_g,
+                                  quant.scale_h), lid2
         return hist_pallas(bt, g, h, c, slot, num_slots, num_bins), lid2
     return hist_routed_onehot(bins, g, h, c, leaf_id, tables, na_bin,
                               num_slots, num_bins)

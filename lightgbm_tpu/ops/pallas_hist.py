@@ -157,6 +157,172 @@ def hist_leaf_pallas(bins_T, g, h, c, num_bins: int,
 
 
 # ---------------------------------------------------------------------------
+# int8 quantized-gradient histogram kernel
+#
+# LightGBM 4.x technique ("Quantized Training of Gradient Boosting Decision
+# Trees", Shi et al.): gradients/hessians are quantized to int8 with
+# stochastic rounding once per tree, histograms accumulate exactly in int32,
+# and leaf values are renewed from exact f32 sums at tree end. On the MXU
+# this turns the dominant contraction from 5 bf16 channels into 3 int8
+# channels at 2x int8 throughput — ~3.3x fewer effective flops. The int32
+# accumulator is exact up to ~16M rows/shard per (slot, feature, bin) cell
+# (127 * 16.9M = 2^31), far beyond any real per-cell mass.
+# ---------------------------------------------------------------------------
+
+def _kernel_q8(bins_ref, gq_ref, hq_ref, c_ref, slot_ref, out_ref, *,
+               fg: int, b: int, s: int, chunk: int):
+    """One (feature-group j, row-chunk i) grid step, int8 x int8 -> int32.
+
+    bins_ref: [Fg, C] uint8; gq/hq/c_ref: [C] int8; slot_ref: [C] i32;
+    out_ref: [Fg*B, S*3] i32 accumulated across i.
+    """
+    i = pl.program_id(1)
+
+    @pl.when(i == 0)
+    def _():
+        out_ref[:] = jnp.zeros_like(out_ref)
+
+    bins_i = bins_ref[:].astype(jnp.int32)                      # [Fg, C]
+    bb = jax.lax.broadcast_in_dim(bins_i, (fg, b, chunk), (0, 2))
+    iota_b = jax.lax.broadcasted_iota(jnp.int32, (fg, b, chunk), 1)
+    onehot = (bb == iota_b).astype(jnp.int8).reshape(fg * b, chunk)
+
+    # weights [S*3, C] int8: (gq, hq, count) broadcast to slot groups, masked
+    # by the row's slot (mask arithmetic in int32 — Mosaic's narrow-bitwidth
+    # select support is spotty; the final cast to int8 is exact)
+    g = gq_ref[:].reshape(1, chunk).astype(jnp.int32)
+    h = hq_ref[:].reshape(1, chunk).astype(jnp.int32)
+    c = c_ref[:].reshape(1, chunk).astype(jnp.int32)
+    ghc = jnp.concatenate([g, h, c], axis=0)                    # [3, C] i32
+    w = jax.lax.broadcast_in_dim(ghc, (s, 3, chunk), (1, 2)) \
+        .reshape(s * 3, chunk)                                  # [S*3, C]
+    slot = slot_ref[:].reshape(1, chunk)
+    slot_of_row = jax.lax.broadcasted_iota(jnp.int32, (s * 3, chunk), 0) // 3
+    w = jnp.where(slot == slot_of_row, w, 0).astype(jnp.int8)
+
+    part = jax.lax.dot_general(
+        onehot, w, dimension_numbers=(((1,), (1,)), ((), ())),
+        preferred_element_type=jnp.int32)                       # [Fg*B, S*3]
+    out_ref[:] += part
+
+
+def hist_pallas_q8(bins_T: jnp.ndarray, gq: jnp.ndarray, hq: jnp.ndarray,
+                   cq: jnp.ndarray, slot: jnp.ndarray, num_slots: int,
+                   num_bins: int, scale_g, scale_h, chunk: int = _CHUNK,
+                   interpret: bool = False) -> jnp.ndarray:
+    """Slot-routed histogram from int8-quantized channels.
+
+    gq/hq: [N] int8 (stochastic-rounded, see ops/histogram.py quantize_sr);
+    cq: [N] int8 0/1 bag mask; scale_g/scale_h: the quantization scales
+    (traced f32 scalars). Returns [S, 3, F, B] f32 with grad/hess channels
+    dequantized (count channel is exact)."""
+    f, n = bins_T.shape
+    b, s = num_bins, num_slots
+
+    fg = max(1, min(f, _ACC_ROWS_MAX // b))
+    n_fg = -(-f // fg)
+    f_pad = n_fg * fg
+    if f_pad != f:
+        bins_T = jnp.pad(bins_T, ((0, f_pad - f), (0, 0)))
+
+    bins_T = _pad_rows(bins_T, chunk)
+    gq = _pad_rows(gq, chunk)
+    hq = _pad_rows(hq, chunk)
+    cq = _pad_rows(cq, chunk)
+    slot = _pad_rows(slot, chunk, value=s)
+    slot = jnp.minimum(slot, s)
+    n_chunks = bins_T.shape[1] // chunk
+
+    kern = functools.partial(_kernel_q8, fg=fg, b=b, s=s, chunk=chunk)
+    out = pl.pallas_call(
+        kern,
+        grid=(n_fg, n_chunks),
+        in_specs=[
+            pl.BlockSpec((fg, chunk), lambda j, i: (j, i),
+                         memory_space=pltpu.VMEM),
+            pl.BlockSpec((chunk,), lambda j, i: (i,),
+                         memory_space=pltpu.VMEM),
+            pl.BlockSpec((chunk,), lambda j, i: (i,),
+                         memory_space=pltpu.VMEM),
+            pl.BlockSpec((chunk,), lambda j, i: (i,),
+                         memory_space=pltpu.VMEM),
+            pl.BlockSpec((chunk,), lambda j, i: (i,),
+                         memory_space=pltpu.VMEM),
+        ],
+        out_specs=pl.BlockSpec((fg * b, s * 3), lambda j, i: (j, 0),
+                               memory_space=pltpu.VMEM),
+        out_shape=jax.ShapeDtypeStruct((f_pad * b, s * 3), jnp.int32),
+        cost_estimate=pl.CostEstimate(
+            flops=2 * n * f_pad * b * s * 3,
+            bytes_accessed=n * (f_pad + 7) + f_pad * b * s * 12,
+            transcendentals=0),
+        interpret=interpret,
+    )(bins_T, gq, hq, cq, slot)
+
+    out = out.reshape(f_pad, b, s, 3).astype(jnp.float32)
+    sg = scale_g * jnp.float32(1.0 / 127.0)
+    sh = scale_h * jnp.float32(1.0 / 127.0)
+    hist = jnp.stack([out[..., 0] * sg, out[..., 1] * sh, out[..., 2]],
+                     axis=-1).transpose(2, 3, 0, 1)
+    return hist[:, :, :f, :]
+
+
+def _leaf_sums_kernel(g_ref, h_ref, c_ref, lid_ref, out_ref, *,
+                      l: int, chunk: int):
+    """Exact per-leaf (grad, hess, count) sums: [5, L] f32 (hi/lo split)."""
+    i = pl.program_id(0)
+
+    @pl.when(i == 0)
+    def _():
+        out_ref[:] = jnp.zeros_like(out_ref)
+
+    g = g_ref[:].reshape(1, chunk)
+    h = h_ref[:].reshape(1, chunk)
+    c = c_ref[:].reshape(1, chunk)
+    gh = jnp.concatenate([g, h], axis=0)                         # [2, C] f32
+    hi = gh.astype(jnp.bfloat16)
+    lo = (gh - hi.astype(jnp.float32)).astype(jnp.bfloat16)
+    w = jnp.concatenate([hi, c.astype(jnp.bfloat16), lo], axis=0)  # [5, C]
+    lid = lid_ref[:].reshape(1, chunk)
+    iota_l = jax.lax.broadcasted_iota(jnp.int32, (l, chunk), 0)
+    oh = (lid == iota_l).astype(jnp.bfloat16)                    # [L, C]
+    part = jax.lax.dot_general(
+        w, oh, dimension_numbers=(((1,), (1,)), ((), ())),
+        preferred_element_type=jnp.float32)                      # [5, L]
+    out_ref[:] += part
+
+
+def leaf_sums_pallas(g, h, c, leaf_id, num_leaves: int, chunk: int = 8192,
+                     interpret: bool = False) -> jnp.ndarray:
+    """Per-leaf exact sums [3, L] f32 (the quantized path's leaf renewal:
+    LightGBM 4.x renews leaf values from unquantized sums; reference analog
+    is the exact leaf aggregation in LeafSplits, leaf_splits.hpp:20)."""
+    l = num_leaves
+    n = g.shape[0]
+    g = _pad_rows(g, chunk)
+    h = _pad_rows(h, chunk)
+    c = _pad_rows(c, chunk)
+    lid = _pad_rows(leaf_id, chunk, value=l)   # padded rows -> no leaf
+    n_chunks = g.shape[0] // chunk
+    kern = functools.partial(_leaf_sums_kernel, l=l, chunk=chunk)
+    out = pl.pallas_call(
+        kern,
+        grid=(n_chunks,),
+        in_specs=[
+            pl.BlockSpec((chunk,), lambda i: (i,), memory_space=pltpu.VMEM),
+            pl.BlockSpec((chunk,), lambda i: (i,), memory_space=pltpu.VMEM),
+            pl.BlockSpec((chunk,), lambda i: (i,), memory_space=pltpu.VMEM),
+            pl.BlockSpec((chunk,), lambda i: (i,), memory_space=pltpu.VMEM),
+        ],
+        out_specs=pl.BlockSpec((5, l), lambda i: (0, 0),
+                               memory_space=pltpu.VMEM),
+        out_shape=jax.ShapeDtypeStruct((5, l), jnp.float32),
+        interpret=interpret,
+    )(g, h, c, lid)
+    return jnp.stack([out[0] + out[3], out[1] + out[4], out[2]], axis=0)
+
+
+# ---------------------------------------------------------------------------
 # routing + small-table gathers
 #
 # A plain XLA gather of an [N] index vector from a small [L] table costs ~7ms

@@ -24,7 +24,7 @@ from .mesh import DATA_AXIS
 
 def grow_tree_dp(bins, g, h, c, num_bins, na_bin, feature_mask,
                  gp: GrowParams, mesh: Mesh,
-                 grow_fn=grow_tree, bundle=None
+                 grow_fn=grow_tree, bundle=None, qseed=None
                  ) -> Tuple[TreeArrays, jnp.ndarray]:
     """Grow one tree with rows sharded over ``mesh``'s data axis.
 
@@ -39,6 +39,23 @@ def grow_tree_dp(bins, g, h, c, num_bins, na_bin, feature_mask,
     gp_dp = gp if gp.axis_name == axis else \
         dataclasses.replace(gp, axis_name=axis)
 
+    if gp_dp.quant:
+        # thread the stochastic-rounding seed as an explicit replicated
+        # operand (a closed-over tracer is illegal under shard_map) so the
+        # dither varies per iteration on the dp path too
+        def _fn(b_, g_, h_, c_, nb_, na_, fm_, qs_):
+            return grow_fn(b_, g_, h_, c_, nb_, na_, fm_, gp=gp_dp,
+                           bundle=bundle, qseed=qs_)
+        fn = jax.shard_map(
+            _fn, mesh=mesh,
+            in_specs=(P(axis, None), P(axis), P(axis), P(axis), P(), P(),
+                      P(), P()),
+            out_specs=(TreeArrays(*([P()] * len(TreeArrays._fields))),
+                       P(axis)),
+            check_vma=False,
+        )
+        seed = jnp.int32(0) if qseed is None else qseed
+        return fn(bins, g, h, c, num_bins, na_bin, feature_mask, seed)
     fn = jax.shard_map(
         partial(grow_fn, gp=gp_dp, bundle=bundle),
         mesh=mesh,

@@ -52,6 +52,10 @@ def grow_tree_fp(bins, g, h, c, num_bins, na_bin, feature_mask,
         gp = dataclasses.replace(
             gp, hist_impl="scatter" if jax.default_backend() == "cpu"
             else "onehot")
+    if gp.quant:
+        # quantization without the int8 MXU kernel is all cost and no
+        # benefit: the XLA paths dequantize per row anyway
+        gp = dataclasses.replace(gp, quant=False)
 
     # pad the feature axis to a multiple of the mesh size with dead features
     # (1 bin, masked out) — they can never win a split
