@@ -52,6 +52,12 @@ def parse_args(argv: List[str]) -> Dict[str, str]:
 
 def _load_dataset(path: str, conf: Config, params: Dict, reference=None,
                   num_features_hint: int = 0) -> Dataset:
+    if conf.two_round:
+        # reference: TextReader two-phase loading for >RAM files
+        # (utils/text_reader.h); this loader reads the whole file into memory
+        log.warning("two_round loading is not implemented: the file is read "
+                    "into memory in one pass (use save_binary to avoid "
+                    "re-parsing large files)")
     # binary dataset cache (reference: auto-load of <data>.bin,
     # application.cpp LoadData + save_binary)
     bin_path = path if path.endswith(".bin") else path + ".bin"
@@ -67,8 +73,35 @@ def _load_dataset(path: str, conf: Config, params: Dict, reference=None,
                    group_column=conf.group_column,
                    ignore_column=conf.ignore_column,
                    num_features_hint=num_features_hint)
-    ds = Dataset(pf.X, label=pf.label, weight=pf.weight, group=pf.group,
-                 init_score=pf.init_score, reference=reference, params=params,
+    X, label, weight, group, init = (pf.X, pf.label, pf.weight, pf.group,
+                                     pf.init_score)
+    if conf.num_machines > 1 and not conf.pre_partition and group is not None:
+        log.warning(
+            "num_machines > 1 with query/group data: automatic round-robin "
+            "row sharding cannot split whole queries — every machine keeps "
+            "the FULL file. Pre-partition the data by query and set "
+            "pre_partition=true (reference: dataset_loader.cpp:505 + "
+            "metadata.cpp CheckOrPartition)")
+    if conf.num_machines > 1 and not conf.pre_partition and group is None:
+        # distributed load: every machine reads the file but keeps only its
+        # round-robin row share (dataset_loader.cpp:505-541; pre_partition
+        # means the user already split the file per machine). Ranking data
+        # (group boundaries) must be pre-partitioned by whole queries.
+        from .parallel.mesh import init_distributed
+        from .parallel.dist_data import round_robin_rows
+        import jax as _jax
+        init_distributed(conf)
+        if _jax.process_count() > 1:
+            keep = round_robin_rows(X.shape[0], _jax.process_index(),
+                                    _jax.process_count())
+            X = X[keep]
+            label = label[keep] if label is not None else None
+            weight = weight[keep] if weight is not None else None
+            init = init[keep] if init is not None else None
+            log.info(f"rank {_jax.process_index()}: kept {len(keep)} of "
+                     f"{len(keep) * _jax.process_count()}± rows (round-robin)")
+    ds = Dataset(X, label=label, weight=weight, group=group,
+                 init_score=init, reference=reference, params=params,
                  feature_name=pf.feature_names or "auto")
     if conf.save_binary and reference is None:
         ds.save_binary(bin_path)
@@ -123,6 +156,32 @@ def run_predict(conf: Config, params: Dict) -> None:
     log.info(f"Finished prediction; results saved to {conf.output_result}")
 
 
+def run_refit(conf: Config, params: Dict) -> None:
+    """task=refit: refit leaf values of an existing model to new data
+    (reference: Application::Refit wiring, application.cpp:215-252 ->
+    GBDT::RefitTree, gbdt.cpp:299 — tree STRUCTURE is kept, leaf outputs are
+    recomputed from the new labels' gradients)."""
+    if not conf.data:
+        log.fatal("No data to refit on: set data=<file>")
+    if not conf.input_model:
+        log.fatal("No model file: set input_model=<file>")
+    booster = Booster(model_file=conf.input_model, params=params)
+    nf = booster.num_feature()
+    pf = load_file(conf.data, header=conf.header,
+                   label_column=conf.label_column,
+                   weight_column=conf.weight_column,
+                   group_column=conf.group_column,
+                   ignore_column=conf.ignore_column, num_features_hint=nf)
+    if pf.label is None:
+        log.fatal("Refit requires labels in the data file")
+    X = pf.X
+    if X.shape[1] < nf:
+        X = np.pad(X, ((0, 0), (0, nf - X.shape[1])))
+    new_b = booster.refit(X, pf.label, weight=pf.weight, group=pf.group)
+    new_b.save_model(conf.output_model)
+    log.info(f"Finished refit; model saved to {conf.output_model}")
+
+
 def run_convert_model(conf: Config, params: Dict) -> None:
     if not conf.input_model:
         log.fatal("No model file: set input_model=<file>")
@@ -141,8 +200,10 @@ def main(argv: List[str]) -> int:
     params = parse_args(argv)
     conf = Config(params)
     task = conf.task
-    if task == "train" or task == "refit":
+    if task == "train":
         run_train(conf, params)
+    elif task == "refit" or task == "refit_tree":
+        run_refit(conf, params)
     elif task == "predict" or task == "prediction" or task == "test":
         run_predict(conf, params)
     elif task == "convert_model":

@@ -34,9 +34,45 @@ from .utils import log
 from .io import model_text
 
 
-def _to_numpy_2d(data) -> np.ndarray:
+def _is_scipy_sparse(data) -> bool:
+    try:
+        import scipy.sparse as sps
+    except Exception:  # pragma: no cover
+        return False
+    return sps.issparse(data)
+
+
+def _data_from_pandas(df, pandas_categorical: Optional[List] = None):
+    """Encode a DataFrame to float64, mapping CategoricalDtype columns to their
+    integer codes (reference: _data_from_pandas, python-package
+    basic.py:313-400). At train time (``pandas_categorical=None``) the category
+    lists are captured from the frame; at predict time they REORDER the input's
+    categories so string categoricals map to the same codes as training.
+    Returns (array, pandas_categorical)."""
+    cat_cols = [c for c, dt in zip(df.columns, df.dtypes)
+                if isinstance(dt, pd.CategoricalDtype)]
+    bad = [str(c) for c, dt in zip(df.columns, df.dtypes)
+           if dt == object and c not in cat_cols]
+    if bad:
+        log.fatal("DataFrame.dtypes must be int, float or bool; did you mean "
+                  f"astype('category') for columns {', '.join(bad)}?")
+    if pandas_categorical is None:
+        pandas_categorical = [list(df[c].cat.categories) for c in cat_cols]
+    elif len(cat_cols) != len(pandas_categorical):
+        log.fatal("train and valid/predict DataFrames have different numbers "
+                  "of categorical columns")
+    if cat_cols:
+        df = df.copy(deep=False)
+        for c, cats in zip(cat_cols, pandas_categorical):
+            codes = (df[c].cat.set_categories(cats).cat.codes
+                     .to_numpy(dtype=np.float64))
+            df[c] = np.where(codes < 0, np.nan, codes)  # -1 = NaN/unseen
+    return df.to_numpy(dtype=np.float64, na_value=np.nan), pandas_categorical
+
+
+def _to_numpy_2d(data, pandas_categorical: Optional[List] = None) -> np.ndarray:
     if _PANDAS and isinstance(data, pd.DataFrame):
-        return data.to_numpy(dtype=np.float64, na_value=np.nan)
+        return _data_from_pandas(data, pandas_categorical)[0]
     # f32 input stays f32: the native binner upcasts per value in-register
     # (exact), sparing the 2x host copy at 10M-row scale
     arr = np.asarray(data)
@@ -80,6 +116,7 @@ class Dataset:
         self.free_raw_data = free_raw_data
         self._constructed = False
         self.bundle_meta = None   # set by construct() when EFB bundles
+        self.pandas_categorical = None  # per-cat-column category lists
         # filled by construct():
         self.mappers: List[BinMapper] = []
         self.feature_map: Optional[np.ndarray] = None
@@ -123,14 +160,26 @@ class Dataset:
         conf = params_to_config(self.params)
         if self.reference is not None:
             ref = self.reference.construct()
-            raw = _to_numpy_2d(self.raw_data)
             self.mappers = ref.mappers
             self.feature_map = ref.feature_map
             self._names = ref._names
-            used = raw[:, ref.feature_map] if ref.feature_map is not None else raw
-            bins = np.zeros(used.shape, dtype=np.uint8)
-            for k in range(used.shape[1]):
-                bins[:, k] = ref.mappers[k].values_to_bins(used[:, k]).astype(np.uint8)
+            self.pandas_categorical = getattr(ref, "pandas_categorical", None)
+            if _is_scipy_sparse(self.raw_data):
+                from .binning import bin_sparse_column
+                csc = self.raw_data.tocsc()
+                fm = (ref.feature_map if ref.feature_map is not None
+                      else np.arange(csc.shape[1]))
+                bins = np.empty((csc.shape[0], len(fm)), dtype=np.uint8)
+                for k, j in enumerate(fm):
+                    bin_sparse_column(ref.mappers[k], csc, int(j), bins[:, k])
+            else:
+                raw = _to_numpy_2d(self.raw_data, self.pandas_categorical)
+                used = raw[:, ref.feature_map] if ref.feature_map is not None \
+                    else raw
+                bins = np.zeros(used.shape, dtype=np.uint8)
+                for k in range(used.shape[1]):
+                    bins[:, k] = ref.mappers[k].values_to_bins(
+                        used[:, k]).astype(np.uint8)
             self.bundle_meta = getattr(ref, "bundle_meta", None)
             if self.bundle_meta is not None:
                 from .efb import apply_bundles
@@ -139,13 +188,17 @@ class Dataset:
                                 ref._mtypes_np, ref.max_num_bins)
             return self
 
-        raw = _to_numpy_2d(self.raw_data)
-        columns = (list(self.raw_data.columns)
-                   if _PANDAS and isinstance(self.raw_data, pd.DataFrame) else None)
+        sparse_in = _is_scipy_sparse(self.raw_data)
+        if sparse_in:
+            raw = self.raw_data.tocsc()   # binned column-by-column, no dense
+            columns = None                # f64 intermediate (CSR path,
+        elif _PANDAS and isinstance(self.raw_data, pd.DataFrame):  # c_api.h:146)
+            raw, self.pandas_categorical = _data_from_pandas(self.raw_data)
+            columns = list(self.raw_data.columns)
+        else:
+            raw = _to_numpy_2d(self.raw_data)
+            columns = None
         cats = self._resolve_categorical(raw.shape[1], columns)
-        if _PANDAS and isinstance(self.raw_data, pd.DataFrame):
-            # encode pandas categoricals as their code (reference: basic.py:313-400)
-            raw = raw.copy()
         forced_bins = None
         if conf.forcedbins_filename:
             # reference: forcedbins_filename JSON (bin_serializer usage,
@@ -153,12 +206,39 @@ class Dataset:
             with open(conf.forcedbins_filename) as fh:
                 forced_bins = {int(e["feature"]): e["bin_upper_bound"]
                                for e in json.load(fh)}
-        mappers = find_bin_mappers(
-            raw, max_bin=conf.max_bin, min_data_in_bin=conf.min_data_in_bin,
+        bin_kw = dict(
+            max_bin=conf.max_bin, min_data_in_bin=conf.min_data_in_bin,
             sample_cnt=conf.bin_construct_sample_cnt, categorical=cats,
             use_missing=conf.use_missing, zero_as_missing=conf.zero_as_missing,
             seed=conf.data_random_seed, forced_bins=forced_bins)
-        binned = bin_data(raw, mappers)
+        if sparse_in:
+            if conf.num_machines > 1:
+                from .parallel.mesh import init_distributed
+                init_distributed(conf)
+                if jax.process_count() > 1:
+                    # rank-local mappers would diverge and silently corrupt
+                    # the multi-host histogram psum; refuse loudly
+                    log.fatal("scipy-sparse input is not supported with "
+                              "distributed bin finding (num_machines > 1); "
+                              "densify or use text-file loading")
+            from .binning import bin_data_sparse, find_bin_mappers_sparse
+            mappers = find_bin_mappers_sparse(raw, **bin_kw)
+            binned = bin_data_sparse(raw, mappers)
+        else:
+            distributed = False
+            if conf.num_machines > 1:
+                from .parallel.mesh import init_distributed
+                init_distributed(conf)
+                distributed = jax.process_count() > 1
+            if distributed:
+                # distributed bin finding: feature slices per rank + mapper
+                # allgather — identical mappers on every rank by construction
+                # (dataset_loader.cpp:957-1040)
+                from .parallel.dist_data import find_bin_mappers_distributed
+                mappers = find_bin_mappers_distributed(raw, **bin_kw)
+            else:
+                mappers = find_bin_mappers(raw, **bin_kw)
+            binned = bin_data(raw, mappers)
         self.mappers = binned.mappers
         self.feature_map = binned.feature_map
         self.bundle_meta = None
@@ -254,6 +334,7 @@ class Dataset:
             "init_score": self.init_score,
             "bundle_meta": self.bundle_meta,
             "params": self.params,
+            "pandas_categorical": self.pandas_categorical,
         }
         with open(filename, "wb") as fh:
             pickle.dump(payload, fh)
@@ -276,6 +357,7 @@ class Dataset:
         ds.group = payload["group"]
         ds.init_score = payload["init_score"]
         ds.bundle_meta = payload["bundle_meta"]
+        ds.pandas_categorical = payload.get("pandas_categorical")
         ds._num_features_raw = (int(ds.feature_map.max()) + 1
                                 if ds.feature_map is not None
                                 else payload["bins"].shape[1])
@@ -288,6 +370,48 @@ class Dataset:
                      init_score=None, params=None) -> "Dataset":
         return Dataset(data, label=label, reference=self, weight=weight,
                        group=group, init_score=init_score, params=params)
+
+    def subset(self, used_indices, params: Optional[Dict] = None) -> "Dataset":
+        """Row subset of a CONSTRUCTED dataset sharing its bin mappers —
+        binning happens once (reference: Dataset::CopySubrow via
+        dataset.cpp:808 + python Dataset.subset). The rows are gathered on
+        device from the binned matrix; no raw data needed."""
+        self.construct()
+        idx = np.asarray(used_indices, dtype=np.int64)
+        ds = Dataset(None, params={**self.params, **(params or {})},
+                     free_raw_data=self.free_raw_data)
+        ds.mappers = self.mappers
+        ds.feature_map = self.feature_map
+        ds._names = self._names
+        ds.bundle_meta = self.bundle_meta
+        ds.pandas_categorical = self.pandas_categorical
+        ds.reference = self            # aligned by construction
+        idx_dev = jnp.asarray(idx)
+        ds.bins = jnp.take(self.bins, idx_dev, axis=0)
+        ds.num_bins_dev = self.num_bins_dev
+        ds.na_bin_dev = self.na_bin_dev
+        ds.missing_type_dev = self.missing_type_dev
+        ds._num_bins_np = self._num_bins_np
+        ds._na_bin_raw = self._na_bin_raw
+        ds._mtypes_np = self._mtypes_np
+        ds.max_num_bins = self.max_num_bins
+        ds._num_data = int(len(idx))
+        ds._num_features_raw = self._num_features_raw
+        if self.label is not None:
+            ds.label = jnp.take(jnp.asarray(self.label), idx_dev)
+        if self.weight is not None:
+            ds.weight = jnp.take(jnp.asarray(self.weight), idx_dev)
+        if self.group is not None:
+            # row subsetting cannot preserve arbitrary query boundaries
+            # (reference subset requires sorted whole groups); cv() splits by
+            # whole queries before calling subset
+            log.warning("Dataset.subset on grouped (ranking) data drops the "
+                        "group boundaries unless rows cover whole queries in "
+                        "order; re-set group on the subset if needed")
+        if self.init_score is not None:
+            ds.init_score = np.asarray(self.init_score)[idx]
+        ds._constructed = True
+        return ds
 
     # ---- accessors (reference Dataset API surface) ----
     @property
@@ -449,11 +573,32 @@ class Booster:
             self.trees = self._gbdt.finalize()
         return self.trees
 
+    @property
+    def pandas_categorical(self):
+        """Per-categorical-column category lists captured at train time
+        (reference: Booster.pandas_categorical) — used to encode DataFrame
+        inputs to the same codes at predict time."""
+        if self.train_set is not None:
+            return getattr(self.train_set, "pandas_categorical", None)
+        return self._loaded_meta.get("pandas_categorical")
+
     def predict(self, data, num_iteration: Optional[int] = None,
                 raw_score: bool = False, pred_leaf: bool = False,
                 pred_contrib: bool = False, **kwargs) -> np.ndarray:
         """Batch prediction on raw features (reference: Booster.predict ->
         Predictor, predictor.hpp:29)."""
+        if _is_scipy_sparse(data):
+            # chunked densify: bounded [chunk, F] f64 intermediates instead of
+            # the full dense matrix (reference predicts straight off CSR,
+            # c_api.h:747; our router needs dense rows, so bound the chunk)
+            csr = data.tocsr()
+            chunk = max(1, (64 << 20) // max(1, 8 * csr.shape[1]))
+            outs = [self.predict(np.asarray(csr[i: i + chunk].todense()),
+                                 num_iteration=num_iteration,
+                                 raw_score=raw_score, pred_leaf=pred_leaf,
+                                 pred_contrib=pred_contrib, **kwargs)
+                    for i in range(0, csr.shape[0], chunk)]
+            return np.concatenate(outs, axis=0)
         trees = self._ensure_host_trees()
         k = (self._gbdt.num_tree_per_iteration if self._gbdt
              else int(self._loaded_meta.get("num_tree_per_iteration", 1)))
@@ -461,7 +606,7 @@ class Booster:
             num_iteration = self.best_iteration if self.best_iteration > 0 else -1
         if num_iteration and num_iteration > 0:
             trees = trees[: num_iteration * k]
-        x = _to_numpy_2d(data)
+        x = _to_numpy_2d(data, self.pandas_categorical)
         n = x.shape[0]
         expected = self.num_feature()
         if expected and x.shape[1] != expected:
@@ -597,7 +742,7 @@ class Booster:
         trees = new_b._ensure_host_trees()
         if not trees:
             log.fatal("Cannot refit an empty model")
-        x = _to_numpy_2d(data)
+        x = _to_numpy_2d(data, self.pandas_categorical)
         y = _to_numpy_1d(label)
         obj = new_b._objective_for_predict()
         if obj is None:

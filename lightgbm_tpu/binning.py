@@ -371,6 +371,90 @@ def find_bin_mappers(
     return mappers
 
 
+def find_bin_mappers_sparse(
+    csc,
+    max_bin: int,
+    min_data_in_bin: int = 3,
+    sample_cnt: int = 200000,
+    categorical: Optional[Sequence[int]] = None,
+    use_missing: bool = True,
+    zero_as_missing: bool = False,
+    seed: int = 1,
+    forced_bins: Optional[Dict[int, Sequence[float]]] = None,
+) -> List[BinMapper]:
+    """Per-feature mappers from a scipy CSC matrix WITHOUT densifying.
+
+    The reference's sampling convention (dataset_loader.cpp:867+ /
+    CostructFromSampleData c_api.h:146): only non-zero values are sampled per
+    column; the remainder of the sample is implicit zeros, which
+    BinMapper.from_sample already models via ``total_cnt > len(values)``.
+    """
+    n, f = csc.shape
+    rng = np.random.RandomState(seed)
+    if n > sample_cnt:
+        idx = np.sort(rng.choice(n, sample_cnt, replace=False))
+        sub = csc[idx]           # CSC row selection returns CSC
+        total = sample_cnt
+    else:
+        sub = csc
+        total = n
+    sub = sub.tocsc()
+    cats = set(categorical or ())
+    mappers = []
+    for j in range(f):
+        vals = np.asarray(sub.data[sub.indptr[j]: sub.indptr[j + 1]],
+                          dtype=np.float64)
+        mappers.append(BinMapper.from_sample(
+            vals, total, max_bin,
+            min_data_in_bin=min_data_in_bin,
+            bin_type=BIN_CATEGORICAL if j in cats else BIN_NUMERICAL,
+            use_missing=use_missing,
+            zero_as_missing=zero_as_missing,
+            forced_bounds=(forced_bins or {}).get(j),
+        ))
+    return mappers
+
+
+def bin_sparse_column(mapper: BinMapper, csc, col: int,
+                      out_col: np.ndarray) -> None:
+    """Bin one CSC column into ``out_col`` [N] uint8: absent entries are exact
+    zeros (zero-bin fill), stored non-zeros scatter their bins. Shared by the
+    fresh-mapper and reference-aligned sparse paths."""
+    lo, hi = csc.indptr[col], csc.indptr[col + 1]
+    out_col[:] = np.uint8(mapper.values_to_bins(np.asarray([0.0]))[0])
+    if hi > lo:
+        vals = np.asarray(csc.data[lo:hi], dtype=np.float64)
+        out_col[csc.indices[lo:hi]] = \
+            mapper.values_to_bins(vals).astype(np.uint8)
+
+
+def bin_data_sparse(
+    csc,
+    mappers: List[BinMapper],
+    keep_trivial: bool = False,
+) -> BinnedDataset:
+    """Encode a scipy CSC matrix into the dense uint8 binned matrix column by
+    column — the dense f64 intermediate the reference also avoids
+    (LGBM_DatasetCreateFromCSR, c_api.h:146) never materializes; peak host
+    memory is the [N, F] uint8 output plus one column's non-zeros."""
+    n, f = csc.shape
+    used = [j for j in range(f) if keep_trivial or not mappers[j].is_trivial]
+    if not used:
+        used = [0] if f else []
+    for j in used:
+        if mappers[j].num_bins > 256:
+            log.fatal(f"feature {j}: {mappers[j].num_bins} bins > 256 unsupported")
+    out = np.empty((n, len(used)), dtype=np.uint8)
+    for k, j in enumerate(used):
+        bin_sparse_column(mappers[j], csc, j, out[:, k])
+    return BinnedDataset(
+        bins=out,
+        mappers=[mappers[j] for j in used],
+        raw_num_features=f,
+        feature_map=np.array(used, dtype=np.int32),
+    )
+
+
 def bin_data(
     data: np.ndarray,
     mappers: List[BinMapper],

@@ -93,7 +93,20 @@ def dump_model_text(booster, trees: List[Tree], num_iteration: int = -1,
     else:
         for key, val in sorted(booster.params.items()):
             body += f"[{key}: {val}]\n"
-    body += "end of parameters\n\npandas_categorical:null\n"
+    # trailing pandas category lists (reference python package appends the
+    # same json line so string categoricals map to identical codes at predict
+    # time after a save/load round trip, basic.py _save_pandas_categorical)
+    pc = getattr(booster, "pandas_categorical", None)
+    import json as _json
+
+    def _np_default(o):
+        if hasattr(o, "item"):
+            return o.item()
+        raise TypeError(f"not JSON serializable: {type(o)}")
+
+    pc_str = (_json.dumps(pc, default=_np_default)
+              if pc else "null")
+    body += f"end of parameters\n\npandas_categorical:{pc_str}\n"
     return body
 
 
@@ -105,6 +118,13 @@ def parse_model_text(s: str) -> Tuple[Dict, List[Tree]]:
     if "\nparameters:\n" in s:
         meta["parameters_block"] = s.split("\nparameters:\n", 1)[1].split(
             "end of parameters")[0]
+    if "\npandas_categorical:" in s:
+        import json as _json
+        pc_line = s.rsplit("\npandas_categorical:", 1)[1].splitlines()[0]
+        try:
+            meta["pandas_categorical"] = _json.loads(pc_line)
+        except Exception:
+            meta["pandas_categorical"] = None
     for line in header.splitlines():
         line = line.strip()
         if not line or line == "tree":

@@ -98,7 +98,16 @@ def _resolve_column(spec: str, header_names: Optional[List[str]]) -> int:
     return int(spec)
 
 
-def _resolve_columns(spec, header_names) -> List[int]:
+def _shift_past_label(idx: int, label_idx: int) -> int:
+    """Integer column specs don't count the label column (config.h
+    weight_column docs; dataset_loader.cpp erases the label name before
+    building name2idx) — map a label-removed index back to raw file space."""
+    if idx >= 0 and label_idx >= 0 and idx >= label_idx:
+        return idx + 1
+    return idx
+
+
+def _resolve_columns(spec, header_names, label_idx: int = -1) -> List[int]:
     """Multi-column spec (ignore_column): 'name:a,b' or '0,1,2'."""
     if not spec:
         return []
@@ -106,7 +115,8 @@ def _resolve_columns(spec, header_names) -> List[int]:
     if spec.startswith("name:"):
         names = spec[5:].split(",")
         return [_resolve_column(f"name:{n}", header_names) for n in names]
-    return [int(s) for s in spec.split(",") if s.strip() != ""]
+    return [_shift_past_label(int(s), label_idx)
+            for s in spec.split(",") if s.strip() != ""]
 
 
 class ParsedFile:
@@ -205,7 +215,13 @@ def load_file(path: str, header: bool = False, label_column: str = "",
         if weight_column else -1
     group_idx = _resolve_column(group_column, header_names) if group_column \
         else -1
-    ignore = set(_resolve_columns(ignore_column, header_names))
+    # integer specs are in label-removed space (config.h: "doesn't count the
+    # label column"); name: specs resolve in raw header space
+    if weight_column and not str(weight_column).strip().startswith("name:"):
+        weight_idx = _shift_past_label(weight_idx, label_idx)
+    if group_column and not str(group_column).strip().startswith("name:"):
+        group_idx = _shift_past_label(group_idx, label_idx)
+    ignore = set(_resolve_columns(ignore_column, header_names, label_idx))
 
     label = mat[:, label_idx] if label_idx >= 0 else None
     weight = mat[:, weight_idx] if weight_idx >= 0 else sw

@@ -94,18 +94,25 @@ def _tree_shap_single(tree: Tree, x: np.ndarray, phi: np.ndarray) -> None:
             return
         feat = int(tree.split_feature[ptr])
         v = x[feat]
-        thr = tree.threshold_real[ptr]
-        mt = tree.missing_type[ptr]
-        isnan = np.isnan(v)
-        if mt == 0 and isnan:
-            v, isnan = 0.0, False
-        if mt == 2:
-            miss = isnan
-        elif mt == 1:
-            miss = isnan or abs(v) < 1e-35
+        if tree.is_cat_node[ptr]:
+            # categorical node: left = membership in the cat set (the numeric
+            # threshold is meaningless here — Tree.predict_raw routing)
+            go_left = (not np.isnan(v) and v >= 0
+                       and int(v) in tree._cat_lookup(ptr))
         else:
-            miss = False
-        go_left = tree.default_left[ptr] if miss else (False if isnan else v <= thr)
+            thr = tree.threshold_real[ptr]
+            mt = tree.missing_type[ptr]
+            isnan = np.isnan(v)
+            if mt == 0 and isnan:
+                v, isnan = 0.0, False
+            if mt == 2:
+                miss = isnan
+            elif mt == 1:
+                miss = isnan or abs(v) < 1e-35
+            else:
+                miss = False
+            go_left = tree.default_left[ptr] if miss \
+                else (False if isnan else v <= thr)
         hot = lc[ptr] if go_left else rc[ptr]
         cold = rc[ptr] if go_left else lc[ptr]
         pc = node_count(ptr)

@@ -41,6 +41,10 @@ class GrowParams:
     # voting-parallel: top-k features elected per level for histogram exchange
     # (reference: VotingParallelTreeLearner, top_k config); 0 = off
     voting_top_k: int = 0
+    # per-node feature sampling (reference: feature_fraction_bynode,
+    # serial_tree_learner.cpp:397+) — per-LEVEL per-leaf resampling in the
+    # depthwise grower; 1.0 = off
+    ff_bynode: float = 1.0
     # Data-parallel axis (reference: DataParallelTreeLearner,
     # data_parallel_tree_learner.cpp:149-240). When set, rows are sharded over this
     # mesh axis under shard_map and every histogram / root-sum is psum-ed — the

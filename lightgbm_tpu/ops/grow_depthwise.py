@@ -40,6 +40,19 @@ from .split import (NEG_INF, SplitParams, best_split, leaf_output,
 _OOB = 1 << 20  # out-of-bounds scatter index (dropped with mode="drop")
 
 
+class CEGBState(NamedTuple):
+    """Persistent CEGB bookkeeping (reference: CostEfficientGradientBoosting,
+    cost_effective_gradient_boosting.hpp). Threads ACROSS trees/iterations:
+    ``feature_used`` is model-lifetime 'was feature ever split on' (coupled
+    penalty); ``data_used`` is the per-(row, feature) on-demand bitset (lazy
+    penalty; shape [N, F] when lazy is on, [1, 1] dummy otherwise).
+    Penalty vectors are in grower feature space."""
+    feature_used: jnp.ndarray   # [F] bool
+    data_used: jnp.ndarray      # [N, F] bool (or [1, 1] dummy)
+    coupled_pen: jnp.ndarray    # [F] f32 (zeros when coupled off)
+    lazy_pen: jnp.ndarray       # [F] f32 (zeros when lazy off)
+
+
 class ForcedSplits(NamedTuple):
     """Flattened forcedsplits_filename tree (reference: ForceSplits,
     serial_tree_learner.cpp:456-618): per forced node, the (already
@@ -53,7 +66,12 @@ class ForcedSplits(NamedTuple):
 class _DWState(NamedTuple):
     leaf_id: jnp.ndarray      # [N]
     forced_ptr: jnp.ndarray   # [L] i32: forced-node to apply next (-1 none)
-    vote_mask: jnp.ndarray    # [F] bool: voting-elected features (all-True off)
+    vote_mask: jnp.ndarray    # [L, F] bool: per-leaf features whose columns the
+                              # stored frontier histogram actually holds (voting
+                              # zeroes non-elected columns; a budget-deferred
+                              # leaf must not search features its stored rows
+                              # don't cover — ADVICE r2: starvation). All-True
+                              # when voting is off.
     hist: jnp.ndarray         # [L, 3, F, B] per-leaf histograms (frontier leaves)
     leaf_g: jnp.ndarray       # [L]
     leaf_h: jnp.ndarray
@@ -63,6 +81,7 @@ class _DWState(NamedTuple):
     parent_right: jnp.ndarray # [L] bool
     leaf_min: jnp.ndarray     # [L] monotone output bounds (ConstraintEntry)
     leaf_max: jnp.ndarray
+    cegb: CEGBState           # CEGB bookkeeping (dummy arrays when off)
     tree: TreeArrays
 
 
@@ -76,15 +95,17 @@ def _scatter_set(arr, idx, val, mask):
 def grow_tree_depthwise(bins: jnp.ndarray, g: jnp.ndarray, h: jnp.ndarray,
                         c: jnp.ndarray, num_bins: jnp.ndarray,
                         na_bin: jnp.ndarray, feature_mask: jnp.ndarray,
-                        gp: GrowParams, bundle=None, forced=None, qseed=None
-                        ) -> Tuple[TreeArrays, jnp.ndarray]:
+                        gp: GrowParams, bundle=None, forced=None, qseed=None,
+                        cegb=None):
     """Grow one tree level-wise.
 
     bins: [N, F] uint8; g/h/c: [N] f32 grad/hess/in-bag count channels (already
     masked). Under shard_map with gp.axis_name set, histograms are psum-reduced
     (data-parallel). ``qseed`` (traced i32, e.g. the iteration index) varies
     the stochastic-rounding dither when gp.quant is on. Returns
-    (TreeArrays, leaf_id [N] i32).
+    (TreeArrays, leaf_id [N] i32), plus the updated ``cegb`` CEGBState when one
+    is passed (gp.split.has_cegb; penalties recomputed fresh each level, so the
+    reference's stale-cache fixups in UpdateLeafBestSplits are unnecessary).
     """
     n, f = bins.shape
     L, B = gp.num_leaves, gp.max_bin
@@ -108,11 +129,21 @@ def grow_tree_depthwise(bins: jnp.ndarray, g: jnp.ndarray, h: jnp.ndarray,
     h0 = hist0[1, 0].sum()
     c0 = hist0[2, 0].sum()
 
+    if cegb is None:
+        dummy_b = jnp.zeros(1, bool)
+        cegb = CEGBState(feature_used=dummy_b,
+                         data_used=jnp.zeros((1, 1), bool),
+                         coupled_pen=jnp.zeros(1, jnp.float32),
+                         lazy_pen=jnp.zeros(1, jnp.float32))
+        cegb_on = False
+    else:
+        cegb_on = sp.has_cegb
+
     state = _DWState(
         leaf_id=jnp.zeros(n, dtype=jnp.int32),
         forced_ptr=jnp.full(L, -1, jnp.int32).at[0].set(
             0 if forced is not None else -1),
-        vote_mask=jnp.ones(f, dtype=bool),
+        vote_mask=jnp.ones((L, f), dtype=bool),
         hist=jnp.zeros((L, 3, f, B), jnp.float32).at[0].set(hist0),
         leaf_g=jnp.zeros(L).at[0].set(g0),
         leaf_h=jnp.zeros(L).at[0].set(h0),
@@ -122,6 +153,7 @@ def grow_tree_depthwise(bins: jnp.ndarray, g: jnp.ndarray, h: jnp.ndarray,
         parent_right=jnp.zeros(L, bool),
         leaf_min=jnp.full(L, -jnp.inf),
         leaf_max=jnp.full(L, jnp.inf),
+        cegb=cegb,
         tree=_empty_tree(L, B),
     )
     # root leaf value (kept if nothing splits)
@@ -133,12 +165,47 @@ def grow_tree_depthwise(bins: jnp.ndarray, g: jnp.ndarray, h: jnp.ndarray,
 
     leaves_iota = jnp.arange(L, dtype=jnp.int32)
 
-    def level(st: _DWState, SLOTS: int):
+    def level(st: _DWState, SLOTS: int, lvl):
+        # ---- per-node feature sampling (feature_fraction_bynode;
+        # reference samples per node, serial_tree_learner.cpp:397+ — here
+        # each frontier LEAF draws its own feature subset per level, keyed on
+        # (tree seed, level) so trees and levels decorrelate) ----
+        search_mask = feature_mask & st.vote_mask
+        if gp.ff_bynode < 1.0:
+            kf = max(1, int(round(f * gp.ff_bynode)))
+            seed_base = qseed if qseed is not None else jnp.int32(0)
+            key = jax.random.fold_in(jax.random.PRNGKey(seed_base), lvl)
+            u = jax.random.uniform(key, (L, f))
+            thr = jax.lax.top_k(u, kf)[0][:, -1:]
+            search_mask = search_mask & (u >= thr)
+
+        # ---- CEGB penalty plane (DetlaGain, cegb hpp:51-62): recomputed
+        # fresh each level from current bookkeeping, so a feature that became
+        # used at the previous level is already penalty-free here ----
+        pen = None
+        if cegb_on:
+            pen = jnp.broadcast_to(
+                jnp.float32(sp.cegb_tradeoff * sp.cegb_penalty_split)
+                * st.leaf_c[:, None], (L, f))
+            if sp.cegb_coupled:
+                pen = pen + sp.cegb_tradeoff * jnp.where(
+                    st.cegb.feature_used, 0.0, st.cegb.coupled_pen)[None, :]
+            if sp.cegb_lazy:
+                # on-demand cost: IN-BAG rows in the leaf that haven't paid
+                # for the feature yet (CalculateOndemandCosts iterates only
+                # the bagged partition — c is the in-bag channel)
+                fresh = jnp.where(st.cegb.data_used, 0.0,
+                                  st.cegb.lazy_pen[None, :])      # [N, F]
+                fresh = fresh * (c > 0)[:, None]
+                lazy_cost = _psum(
+                    jax.ops.segment_sum(fresh, st.leaf_id, num_segments=L), gp)
+                pen = pen + sp.cegb_tradeoff * lazy_cost
+
         # ---- best split for every frontier leaf (one batched kernel) ----
         res = best_split(st.hist, num_bins, na_bin, st.leaf_g, st.leaf_h,
-                         st.leaf_c, feature_mask & st.vote_mask, sp, st.active,
+                         st.leaf_c, search_mask, sp, st.active,
                          leaf_min=st.leaf_min, leaf_max=st.leaf_max,
-                         bundle=bundle)
+                         bundle=bundle, gain_penalty=pen)
         if forced is not None:
             # ---- forced splits override the gain search (ForceSplits,
             # serial_tree_learner.cpp:456-618): leaves holding a forced-node
@@ -236,6 +303,20 @@ def grow_tree_depthwise(bins: jnp.ndarray, g: jnp.ndarray, h: jnp.ndarray,
             cat_mask=_scatter_set(tr.cat_mask, node_id, res.cat_member, sel),
         )
 
+        # ---- CEGB bookkeeping (UpdateLeafBestSplits, cegb hpp:63-86):
+        # selected splits mark their feature model-used (coupled) and mark
+        # (row, feature) paid for every row in the split leaf (lazy) ----
+        cegb2 = st.cegb
+        if cegb_on and sp.cegb_coupled:
+            cegb2 = cegb2._replace(feature_used=_scatter_set(
+                cegb2.feature_used, feat, jnp.ones(L, bool), sel))
+        if cegb_on and sp.cegb_lazy:
+            feat_of_leaf = jnp.where(sel, feat, _OOB)
+            f_row = feat_of_leaf[st.leaf_id]                     # [N]
+            f_row = jnp.where(c > 0, f_row, _OOB)  # OOB rows never pay
+            cegb2 = cegb2._replace(data_used=cegb2.data_used.at[
+                jnp.arange(n), f_row].set(True, mode="drop"))
+
         # ---- fused route + child histogram pass ----
         voting = bool(gp.axis_name) and gp.voting_top_k > 0
         small_is_left = lc <= rc
@@ -293,13 +374,20 @@ def grow_tree_depthwise(bins: jnp.ndarray, g: jnp.ndarray, h: jnp.ndarray,
             elected = jax.lax.top_k(elect_key, k)[1]       # [k] feature ids
             sub = jnp.take(hist_pass, elected, axis=2)     # [S_pass, 3, k, B]
             sub = jax.lax.psum(sub, gp.axis_name)
-            vote_mask = jnp.zeros(f, bool).at[elected].set(True)
+            elected_mask = jnp.zeros(f, bool).at[elected].set(True)
             # non-elected entries must NOT keep local (shard-divergent)
             # values: state feeds the replicated split selection and the loop
             # predicates — divergence deadlocks the collectives. Zero them.
-            hist_pass = jnp.where(vote_mask[None, None, :, None],
+            hist_pass = jnp.where(elected_mask[None, None, :, None],
                                   hist_pass.at[:, :, elected, :].set(sub),
                                   0.0)
+            # per-leaf coverage: only leaves whose stored histograms are
+            # REPLACED this level (split leaves + their new siblings) narrow
+            # to the new elected set; budget-deferred leaves keep the mask of
+            # the election their stored rows were measured under
+            em_rows = jnp.broadcast_to(elected_mask[None, :], (L, f))
+            vote_mask = _scatter_set(st.vote_mask, leaves_iota, em_rows, sel)
+            vote_mask = _scatter_set(vote_mask, new_leaf, em_rows, sel)
         else:
             hist_pass = _psum(hist_pass, gp)
             vote_mask = None
@@ -380,6 +468,7 @@ def grow_tree_depthwise(bins: jnp.ndarray, g: jnp.ndarray, h: jnp.ndarray,
             hist=hist2, leaf_g=leaf_g2, leaf_h=leaf_h2,
             leaf_c=leaf_c2, active=active2, parent_node=pn2, parent_right=pr2,
             leaf_min=leaf_min2, leaf_max=leaf_max2,
+            cegb=cegb2,
             tree=tr,
         ), num_sel
 
@@ -401,7 +490,7 @@ def grow_tree_depthwise(bins: jnp.ndarray, g: jnp.ndarray, h: jnp.ndarray,
         # (~25% of whole-tree cost, measured at 10M rows)
         state, last_sel = jax.lax.cond(
             (last_sel > 0) & (state.tree.num_leaves < L),
-            lambda st: level(st, slots_k),
+            lambda st, _s=slots_k, _k=k: level(st, _s, jnp.int32(_k)),
             lambda st: (st, jnp.int32(0)),
             state)
 
@@ -412,7 +501,7 @@ def grow_tree_depthwise(bins: jnp.ndarray, g: jnp.ndarray, h: jnp.ndarray,
 
         def body(carry):
             st, lvl, _ = carry
-            st2, num_sel = level(st, MAX_SLOTS)
+            st2, num_sel = level(st, MAX_SLOTS, lvl)
             return st2, lvl + 1, num_sel
 
         state, _, _ = jax.lax.while_loop(
@@ -438,4 +527,6 @@ def grow_tree_depthwise(bins: jnp.ndarray, g: jnp.ndarray, h: jnp.ndarray,
             leaf_value=jnp.where(live, w, tr.leaf_value),
             leaf_weight=jnp.where(live, eh, tr.leaf_weight),
             leaf_count=jnp.where(live, ec, tr.leaf_count)))
+    if cegb_on:
+        return state.tree, state.leaf_id, state.cegb
     return state.tree, state.leaf_id

@@ -55,10 +55,24 @@ class SplitParams:
     # EFB: bundled columns present (static flag; the BundleArrays data rides
     # along as a traced argument)
     has_bundles: bool = False
+    # CEGB (reference: CostEfficientGradientBoosting,
+    # cost_effective_gradient_boosting.hpp:26-45): per-candidate gain penalty
+    # tradeoff*(penalty_split*n_leaf + coupled[f]*unused(f) + lazy on-demand
+    # cost). The penalty VECTORS are traced (CEGBState); these static fields
+    # gate compilation of the penalty planes.
+    cegb_tradeoff: float = 1.0
+    cegb_penalty_split: float = 0.0
+    cegb_coupled: bool = False
+    cegb_lazy: bool = False
 
     @property
     def has_monotone(self) -> bool:
         return any(m != 0 for m in self.monotone_constraints)
+
+    @property
+    def has_cegb(self) -> bool:
+        return (self.cegb_penalty_split > 0.0 or self.cegb_coupled
+                or self.cegb_lazy)
 
 
 class BundleArrays(NamedTuple):
@@ -160,13 +174,17 @@ def best_split(hist: jnp.ndarray, num_bins: jnp.ndarray, na_bin: jnp.ndarray,
                parent_g, parent_h, parent_cnt,
                feature_mask: jnp.ndarray, p: SplitParams,
                allow_split=True, leaf_min=None, leaf_max=None,
-               bundle=None) -> SplitResult:
+               bundle=None, gain_penalty=None) -> SplitResult:
     """Find the best split for one leaf or a whole frontier of leaves.
 
     hist: [..., 3, F, B] channel-major (grad, hess, count); num_bins: [F] i32
     actual bins per feature; na_bin: [F] i32 missing-bin index (or >= B if
-    none); feature_mask: [F] bool; parent_g/h/cnt and allow_split broadcast
-    over the leading batch dims of hist.
+    none); feature_mask: [F] bool, or per-leaf [*batch, F] bool (voting mode:
+    each frontier leaf may only search features its stored histogram holds);
+    parent_g/h/cnt and allow_split broadcast over the leading batch dims.
+    ``gain_penalty``: optional [*batch, F] f32 subtracted from every candidate
+    gain of that (leaf, feature) — the CEGB delta (DetlaGain,
+    cost_effective_gradient_boosting.hpp:51-62).
     """
     batch_shape = hist.shape[:-3]
     _, f, b = hist.shape[-3:]
@@ -174,6 +192,11 @@ def best_split(hist: jnp.ndarray, num_bins: jnp.ndarray, na_bin: jnp.ndarray,
     for d in batch_shape:
         L *= d
     h3 = hist.reshape(L, 3, f, b)
+    # normalize the feature mask to per-leaf [L, F, 1]
+    fm_lf = (jnp.broadcast_to(feature_mask, batch_shape + (f,)).reshape(L, f)
+             if feature_mask.ndim > 1 else
+             jnp.broadcast_to(feature_mask[None, :], (L, f)))
+    fm3 = fm_lf[:, :, None]                                       # [L, F, 1]
     pg = jnp.broadcast_to(jnp.asarray(parent_g, jnp.float32), batch_shape).reshape(L)
     ph = jnp.broadcast_to(jnp.asarray(parent_h, jnp.float32), batch_shape).reshape(L)
     pc = jnp.broadcast_to(jnp.asarray(parent_cnt, jnp.float32), batch_shape).reshape(L)
@@ -235,12 +258,19 @@ def best_split(hist: jnp.ndarray, num_bins: jnp.ndarray, na_bin: jnp.ndarray,
     cat_mask_dev = jnp.asarray(cat_mask_f)
 
     valid_t = (iota < num_bins[None, :, None] - 1) & (~na_sel) \
-        & feature_mask[None, :, None] & (~cat_mask_dev)[None, :, None]
+        & fm3 & (~cat_mask_dev)[None, :, None]
     if p.has_bundles and bundle is not None:
         valid_t = valid_t & (~bundle.is_bundle)[None, :, None]
     has_na = na < b
     gain_r = jnp.where(valid_t, gain_r, NEG_INF)
     gain_l = jnp.where(valid_t & has_na, gain_l, NEG_INF)
+
+    pen_lf = None
+    if gain_penalty is not None:
+        pen_lf = (jnp.broadcast_to(gain_penalty, batch_shape + (f,))
+                  .reshape(L, f).astype(jnp.float32))
+        gain_r = gain_r - pen_lf[:, :, None]
+        gain_l = gain_l - pen_lf[:, :, None]
 
     sections = [gain_r.reshape(L, f * b), gain_l.reshape(L, f * b)]
 
@@ -254,7 +284,7 @@ def best_split(hist: jnp.ndarray, num_bins: jnp.ndarray, na_bin: jnp.ndarray,
         gch, hch, cch = hcat[:, 0], hcat[:, 1], hcat[:, 2]       # [L, Fc, B]
         nb_c = num_bins[cat_idx][None, :, None]                  # [1, Fc, 1]
         iota_c = jnp.arange(b, dtype=jnp.int32)[None, None, :]
-        fm_c = feature_mask[cat_idx][None, :, None]
+        fm_c = fm_lf[:, cat_idx][:, :, None]                     # [L, Fc, 1]
         # bin 0 is the other/missing bin (binning.py): always routed RIGHT so
         # exported bitsets stay exact (reference: NaN/unseen -> right,
         # tree.h CategoricalDecision)
@@ -327,6 +357,11 @@ def best_split(hist: jnp.ndarray, num_bins: jnp.ndarray, na_bin: jnp.ndarray,
         gain_asc = subset_gains(*asc)
         gain_desc = subset_gains(*desc)
         left_asc, left_desc = asc, desc
+        if pen_lf is not None:
+            pen_c = pen_lf[:, cat_idx][:, :, None]
+            gain_oh = gain_oh - pen_c
+            gain_asc = gain_asc - pen_c
+            gain_desc = gain_desc - pen_c
         sections += [gain_oh.reshape(L, fc * b), gain_asc.reshape(L, fc * b),
                      gain_desc.reshape(L, fc * b)]
 
@@ -357,7 +392,7 @@ def best_split(hist: jnp.ndarray, num_bins: jnp.ndarray, na_bin: jnp.ndarray,
                & (lhB >= p.min_sum_hessian_in_leaf)
                & (rhB >= p.min_sum_hessian_in_leaf)
                & bundle.valid[None, :, :] & bundle.is_bundle[None, :, None]
-               & feature_mask[None, :, None])
+               & fm3)
         if p.has_monotone:
             # bundled features are never themselves monotone-constrained
             # (Dataset excludes them from bundling), but the LEAF's output
@@ -369,6 +404,8 @@ def best_split(hist: jnp.ndarray, num_bins: jnp.ndarray, na_bin: jnp.ndarray,
         else:
             gainB = leaf_split_gain(lgB, lhB, p) + leaf_split_gain(rgB, rhB, p)
         gainB = jnp.where(okB, gainB, NEG_INF)
+        if pen_lf is not None:
+            gainB = gainB - pen_lf[:, :, None]
         sections.append(gainB.reshape(L, f * b))
 
     gains = jnp.concatenate(sections, axis=1)

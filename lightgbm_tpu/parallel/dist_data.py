@@ -1,0 +1,138 @@
+"""Distributed data loading + distributed bin finding.
+
+Reference analogs:
+- round-robin row sharding when ``pre_partition=false``: machine ``rank``
+  keeps rows with ``global_idx % num_machines == rank``
+  (dataset_loader.cpp:505-541);
+- distributed bin finding: the feature set is sliced into contiguous blocks,
+  each rank runs FindBin on ITS block using its LOCAL row sample, and the
+  serialized BinMappers are allgathered so every rank holds an identical
+  mapper list (dataset_loader.cpp:957-1040 + Network::Allgather).
+
+TPU-native mechanics: mappers are encoded into a fixed-width f64 matrix and
+exchanged with a single ``process_allgather`` (jax.distributed replaces the
+reference's socket/MPI linkers); identical mappers on every rank are then a
+construction-time invariant, which is what keeps multi-host histograms
+consistent (divergent mappers would silently corrupt the psum).
+"""
+from __future__ import annotations
+
+from typing import List, Optional, Sequence
+
+import numpy as np
+
+from ..binning import (BIN_CATEGORICAL, BIN_NUMERICAL, BinMapper,
+                       find_bin_mappers)
+from ..utils import log
+
+
+def round_robin_rows(n_rows: int, rank: int, num_machines: int) -> np.ndarray:
+    """Row indices this rank keeps (dataset_loader.cpp:505-541)."""
+    return np.arange(rank, n_rows, num_machines)
+
+
+def feature_slice(num_features: int, rank: int, num_machines: int):
+    """Contiguous feature block owned by ``rank`` for distributed bin finding
+    (dataset_loader.cpp:957: step = ceil(total / num_machines))."""
+    step = (num_features + num_machines - 1) // num_machines
+    lo = min(step * rank, num_features)
+    hi = min(lo + step, num_features)
+    return lo, hi
+
+
+# ---- fixed-width mapper codec (the Allgather payload) ----
+# row layout: [bin_type, missing_type, num_bins, default_bin, most_freq_bin,
+#              is_trivial, sparse_rate, min_value, max_value, n_payload,
+#              payload...]; payload = upper_bounds (numerical, may contain
+#              NaN for the NaN bin) or cat_values (categorical)
+_HDR = 10
+
+
+def _encode_mapper(m: BinMapper, width: int) -> np.ndarray:
+    row = np.zeros(width, dtype=np.float64)
+    payload = (m.cat_values.astype(np.float64)
+               if m.bin_type == BIN_CATEGORICAL else
+               np.asarray(m.upper_bounds, dtype=np.float64))
+    if _HDR + len(payload) > width:
+        log.fatal(f"mapper payload {len(payload)} exceeds codec width {width}")
+    row[0] = m.bin_type
+    row[1] = m.missing_type
+    row[2] = m.num_bins
+    row[3] = m.default_bin
+    row[4] = m.most_freq_bin
+    row[5] = 1.0 if m.is_trivial else 0.0
+    row[6] = m.sparse_rate
+    row[7] = m.min_value
+    row[8] = m.max_value
+    row[9] = len(payload)
+    row[_HDR: _HDR + len(payload)] = payload
+    return row
+
+
+def _decode_mapper(row: np.ndarray) -> BinMapper:
+    n_payload = int(row[9])
+    payload = row[_HDR: _HDR + n_payload]
+    bin_type = int(row[0])
+    m = BinMapper(
+        num_bins=int(row[2]),
+        bin_type=bin_type,
+        missing_type=int(row[1]),
+        upper_bounds=(payload.copy() if bin_type == BIN_NUMERICAL
+                      else np.array([np.inf])),
+        cat_values=(payload.astype(np.int64) if bin_type == BIN_CATEGORICAL
+                    else np.array([], dtype=np.int64)),
+    )
+    m.default_bin = int(row[3])
+    m.most_freq_bin = int(row[4])
+    m.is_trivial = bool(row[5] > 0.5)
+    m.sparse_rate = float(row[6])
+    m.min_value = float(row[7])
+    m.max_value = float(row[8])
+    return m
+
+
+def find_bin_mappers_distributed(
+    raw_local: np.ndarray,
+    max_bin: int,
+    min_data_in_bin: int = 3,
+    sample_cnt: int = 200000,
+    categorical: Optional[Sequence[int]] = None,
+    use_missing: bool = True,
+    zero_as_missing: bool = False,
+    seed: int = 1,
+    forced_bins=None,
+) -> List[BinMapper]:
+    """Identical-by-construction mappers across jax.distributed processes.
+
+    Each process finds bins for its feature slice from its LOCAL rows (the
+    reference's exact division of labor), then one allgather distributes the
+    encoded mappers; every process decodes the same full list.
+    """
+    import jax
+    from jax.experimental import multihost_utils
+
+    nm = jax.process_count()
+    rank = jax.process_index()
+    f = raw_local.shape[1]
+    lo, hi = feature_slice(f, rank, nm)
+
+    local = find_bin_mappers(
+        raw_local[:, lo:hi] if hi > lo else raw_local[:, :0],
+        max_bin=max_bin, min_data_in_bin=min_data_in_bin,
+        sample_cnt=sample_cnt,
+        categorical=[c - lo for c in (categorical or ()) if lo <= c < hi],
+        use_missing=use_missing, zero_as_missing=zero_as_missing,
+        seed=seed + rank,
+        forced_bins={k - lo: v for k, v in (forced_bins or {}).items()
+                     if lo <= k < hi})
+
+    width = _HDR + max_bin + 2
+    enc = np.zeros((f, width), dtype=np.float64)
+    for j, m in enumerate(local):
+        enc[lo + j] = _encode_mapper(m, width)
+    # one collective replaces the reference's serialized-BinMapper Allgather
+    # (dataset_loader.cpp:1028); summing is exact because every rank
+    # contributes zeros outside its own slice
+    gathered = np.asarray(multihost_utils.process_allgather(enc))  # [nm, F, W]
+    full = gathered.sum(axis=0)
+    return [_decode_mapper(full[j]) for j in range(f)]

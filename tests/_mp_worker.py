@@ -1,0 +1,110 @@
+"""Worker for test_multiprocess.py — runs as one of two jax.distributed
+processes. See that file for what is being asserted."""
+import hashlib
+import os
+import sys
+
+import numpy as np
+
+os.environ.setdefault("JAX_PLATFORMS", "cpu")
+
+import jax  # noqa: E402
+
+# the axon TPU plugin ignores JAX_PLATFORMS; force the CPU backend explicitly
+# (same workaround as tests/conftest.py) and pick gloo so the CPU client
+# federates across the two processes
+jax.config.update("jax_platforms", "cpu")
+jax.config.update("jax_cpu_collectives_implementation", "gloo")
+
+import jax.numpy as jnp  # noqa: E402
+
+sys.path.insert(0, "/root/repo")
+
+from lightgbm_tpu.config import Config  # noqa: E402
+from lightgbm_tpu.binning import bin_data  # noqa: E402
+from lightgbm_tpu.io.parser import load_file  # noqa: E402
+from lightgbm_tpu.ops.grow import GrowParams  # noqa: E402
+from lightgbm_tpu.ops.split import SplitParams  # noqa: E402
+from lightgbm_tpu.parallel.data_parallel import grow_tree_dp  # noqa: E402
+from lightgbm_tpu.parallel.dist_data import (_encode_mapper,  # noqa: E402
+                                             find_bin_mappers_distributed,
+                                             round_robin_rows)
+from lightgbm_tpu.parallel.mesh import init_distributed  # noqa: E402
+
+
+def _digest(arrs) -> np.ndarray:
+    h = hashlib.sha256()
+    for a in arrs:
+        h.update(np.ascontiguousarray(a).tobytes())
+    return np.frombuffer(h.digest()[:8], dtype=np.int64).astype(np.float64)
+
+
+def main():
+    port, data_path = sys.argv[1], sys.argv[2]
+    conf = Config({"num_machines": 2,
+                   "machines": f"127.0.0.1:{port},127.0.0.1:0"})
+    init_distributed(conf)
+    assert jax.process_count() == 2, jax.process_count()
+    rank = jax.process_index()
+    from jax.experimental import multihost_utils
+
+    # ---- distributed load: round-robin row share of the same file ----
+    pf = load_file(data_path)
+    keep = round_robin_rows(pf.X.shape[0], rank, 2)
+    Xl = pf.X[keep]
+    yl = pf.label[keep]
+
+    # ---- distributed bin finding + mapper equality across ranks ----
+    MAXB = 16
+    mappers = find_bin_mappers_distributed(Xl, max_bin=MAXB, sample_cnt=50000)
+    enc = np.stack([_encode_mapper(m, MAXB + 12) for m in mappers])
+    digests = np.asarray(multihost_utils.process_allgather(_digest([enc])))
+    assert digests.shape[0] >= 2 and np.all(digests == digests[0]), \
+        f"mappers diverge: {digests}"
+
+    # ---- one data-parallel training step over the global 2-process mesh ----
+    binned = bin_data(Xl, mappers)
+    n_all = np.asarray(multihost_utils.process_allgather(
+        np.asarray([binned.bins.shape[0]], np.int64)))
+    n_eq = int(n_all.max())
+    pad = n_eq - binned.bins.shape[0]
+    bins_l = np.pad(binned.bins, ((0, pad), (0, 0)))
+    y_l = np.pad(np.asarray(yl), (0, pad))
+    mask_l = np.pad(np.ones(binned.bins.shape[0], np.float32), (0, pad))
+
+    from jax.sharding import Mesh, NamedSharding, PartitionSpec as P
+    mesh = Mesh(np.array(jax.devices()), ("data",))
+    row = NamedSharding(mesh, P("data"))
+    mat = NamedSharding(mesh, P("data", None))
+    bins_g = jax.make_array_from_process_local_data(mat, bins_l)
+    # binary-objective gradients at score 0 (p = 0.5): g = 0.5 - y, h = 0.25
+    g_g = jax.make_array_from_process_local_data(
+        row, ((0.5 - y_l) * mask_l).astype(np.float32))
+    h_g = jax.make_array_from_process_local_data(
+        row, (0.25 * mask_l).astype(np.float32))
+    c_g = jax.make_array_from_process_local_data(row, mask_l)
+
+    f = bins_l.shape[1]
+    num_bins = jnp.asarray([m.num_bins for m in binned.mappers],
+                           dtype=jnp.int32)
+    na = np.asarray([m.na_bin for m in binned.mappers], np.int32)
+    na_bin = jnp.asarray(np.where(na < 0, 256, na).astype(np.int32))
+    fmask = jnp.ones(f, dtype=bool)
+    gp = GrowParams(num_leaves=8, max_bin=MAXB,
+                    split=SplitParams(min_data_in_leaf=5),
+                    hist_impl="scatter")
+    tree, leaf_id = grow_tree_dp(bins_g, g_g, h_g, c_g, num_bins, na_bin,
+                                 fmask, gp, mesh)
+    nl = int(np.asarray(tree.num_leaves))
+    assert nl > 1, "tree did not split"
+    td = _digest([np.asarray(tree.split_feature),
+                  np.asarray(tree.threshold_bin),
+                  np.asarray(tree.leaf_value)])
+    tds = np.asarray(multihost_utils.process_allgather(td))
+    assert np.all(tds == tds[0]), f"trees diverge across ranks: {tds}"
+
+    print(f"MP_WORKER_OK rank={rank} num_leaves={nl}")
+
+
+if __name__ == "__main__":
+    main()

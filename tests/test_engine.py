@@ -285,6 +285,8 @@ def test_forced_splits(tmp_path):
 
 
 def test_unconsumed_params_warn():
+    # pred_early_stop is the remaining accepted-but-N/A param (CEGB and
+    # feature_fraction_bynode are implemented now)
     import lightgbm_tpu.utils.log as lgb_log
     msgs = []
     lgb_log.set_callback(lambda s: msgs.append(s))
@@ -292,13 +294,12 @@ def test_unconsumed_params_warn():
         X = np.random.RandomState(23).randn(200, 3)
         y = X[:, 0]
         lgb.train({**_P, "verbosity": 0, "objective": "regression",
-                   "cegb_tradeoff": 2.0, "feature_fraction_bynode": 0.5},
+                   "pred_early_stop": True},
                   lgb.Dataset(X, label=y), num_boost_round=1)
     finally:
         lgb_log.set_callback(None)
     joined = "".join(msgs)
-    assert "cegb_tradeoff is ignored" in joined
-    assert "feature_fraction_bynode is ignored" in joined
+    assert "pred_early_stop is ignored" in joined
 
 
 def test_forced_bins(tmp_path):

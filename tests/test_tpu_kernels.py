@@ -1,0 +1,28 @@
+"""Compiled Pallas kernel equivalence on real TPU hardware (round-2 VERDICT
+weak #8: the suite only ever ran the kernels in interpret mode on CPU, which
+hides Mosaic-specific miscompiles).
+
+The check runs in a SUBPROCESS because conftest pins this suite to the CPU
+backend; the child process uses the default (TPU when present) backend and
+skips cleanly when no TPU is attached.
+"""
+import os
+import subprocess
+import sys
+
+import pytest
+
+_CHECK = os.path.join(os.path.dirname(__file__), "_tpu_kernel_check.py")
+
+
+def test_compiled_pallas_kernels_on_tpu():
+    env = {k: v for k, v in os.environ.items()
+           if k not in ("JAX_PLATFORMS", "XLA_FLAGS")}
+    proc = subprocess.run([sys.executable, _CHECK], env=env,
+                          stdout=subprocess.PIPE, stderr=subprocess.STDOUT,
+                          timeout=900, cwd="/root/repo")
+    out = proc.stdout.decode("utf-8", "replace")
+    if proc.returncode == 3:
+        pytest.skip(f"no TPU backend available: {out.strip().splitlines()[-1]}")
+    assert proc.returncode == 0, f"kernel check failed:\n{out[-4000:]}"
+    assert "TPU_KERNELS_OK" in out
