@@ -133,35 +133,43 @@ def _phase_breakdown(booster, ds, n_rows, file):
     lid = jnp.zeros(n, jnp.int32)
     hist_state = jnp.zeros((L, 3, f, B), jnp.float32) + 1.0
 
-    def t_loop(name, op, K=6):
-        def loop(k, x0):
+    def t_loop(name, op, *big, K=6):
+        # the large arrays are explicit jit ARGUMENTS — closing over a 10M-row
+        # device array would embed it as a constant in the compile payload
+        # (the tunneled compile service rejects those with HTTP 413)
+        def loop(k, x0, *a):
             return jax.lax.fori_loop(
-                0, k, lambda i, acc: acc + op(acc * 0 + 1 + i * 1e-9), x0)
+                0, k, lambda i, acc: acc + op(acc * 0 + 1 + i * 1e-9, *a), x0)
         f1 = jax.jit(_partial(loop, 1))
         fK = jax.jit(_partial(loop, K))
         x0 = jnp.zeros((), jnp.float32)
-        jax.block_until_ready(f1(x0)); jax.block_until_ready(fK(x0))
-        t0 = time.time(); jax.block_until_ready(f1(x0)); t1 = time.time() - t0
-        t0 = time.time(); jax.block_until_ready(fK(x0)); tK = time.time() - t0
+        jax.block_until_ready(f1(x0, *big))
+        jax.block_until_ready(fK(x0, *big))
+        t0 = time.time(); jax.block_until_ready(f1(x0, *big))
+        t1 = time.time() - t0
+        t0 = time.time(); jax.block_until_ready(fK(x0, *big))
+        tK = time.time() - t0
         print(f"# phase {name}: {(tK - t1) / (K - 1) * 1000:.2f} ms/op",
               file=file)
 
-    t_loop("hist_root", lambda s: HH.hist_leaf(
-        bins, g * s, g, g, B, gp.hist_impl, bins_T=bins_T).sum())
+    t_loop("hist_root", lambda s, bb, bt, gg: HH.hist_leaf(
+        bb, gg * s, gg, gg, B, gp.hist_impl, bins_T=bt).sum(),
+        bins, bins_T, g)
     S = min(128, (L + 1) // 2 + 1)
     tables = HH.RouteTables(
         feat=jnp.zeros(L, jnp.int32), thr=jnp.full(L, B // 2, jnp.int32),
         dleft=jnp.zeros(L, jnp.int32), new_leaf=jnp.arange(L, dtype=jnp.int32),
         slot_left=jnp.zeros(L, jnp.int32), slot_right=jnp.ones(L, jnp.int32))
-    t_loop(f"hist_level_S{S}", lambda s: HH.hist_routed(
-        bins, g * s, g, g, lid, tables, ds.na_bin_dev, S, B,
-        gp.hist_impl, bins_T=bins_T)[0].sum())
-    t_loop("best_split_frontier", lambda s: best_split(
-        hist_state * s, ds.num_bins_dev, ds.na_bin_dev,
+    t_loop(f"hist_level_S{S}", lambda s, bb, bt, gg, ll: HH.hist_routed(
+        bb, gg * s, gg, gg, ll, tables, ds.na_bin_dev, S, B,
+        gp.hist_impl, bins_T=bt)[0].sum(), bins, bins_T, g, lid)
+    t_loop("best_split_frontier", lambda s, hh: best_split(
+        hh * s, ds.num_bins_dev, ds.na_bin_dev,
         jnp.ones(L), jnp.ones(L) * 10, jnp.full(L, float(n)),
-        jnp.ones(f, bool), gp.split, jnp.ones(L, bool)).gain.sum())
+        jnp.ones(f, bool), gp.split, jnp.ones(L, bool)).gain.sum(),
+        hist_state)
     lv = jnp.zeros(L, jnp.float32) + 0.5
-    t_loop("score_update", lambda s: take_small(lv * s, lid).sum())
+    t_loop("score_update", lambda s, ll: take_small(lv * s, ll).sum(), lid)
 
 
 if __name__ == "__main__":

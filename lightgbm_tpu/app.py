@@ -59,9 +59,12 @@ def _load_dataset(path: str, conf: Config, params: Dict, reference=None,
                     "into memory in one pass (use save_binary to avoid "
                     "re-parsing large files)")
     # binary dataset cache (reference: auto-load of <data>.bin,
-    # application.cpp LoadData + save_binary)
+    # application.cpp LoadData + save_binary). Disabled for auto-partitioned
+    # distributed runs: every rank would race-write its ROW SHARD to the same
+    # path, and a stale full-data cache would skip the round-robin sharding
+    use_bin_cache = not (conf.num_machines > 1 and not conf.pre_partition)
     bin_path = path if path.endswith(".bin") else path + ".bin"
-    if os.path.exists(bin_path) and reference is None:
+    if use_bin_cache and os.path.exists(bin_path) and reference is None:
         try:
             ds = Dataset.load_binary(bin_path, params=params)
             log.info(f"Loaded binned dataset from {bin_path}")
@@ -104,7 +107,12 @@ def _load_dataset(path: str, conf: Config, params: Dict, reference=None,
                  init_score=init, reference=reference, params=params,
                  feature_name=pf.feature_names or "auto")
     if conf.save_binary and reference is None:
-        ds.save_binary(bin_path)
+        if use_bin_cache:
+            ds.save_binary(bin_path)
+        else:
+            log.warning("save_binary is ignored for auto-partitioned "
+                        "distributed loading (ranks hold different row "
+                        "shards); use pre_partition=true with per-rank files")
     return ds
 
 

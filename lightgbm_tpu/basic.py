@@ -211,6 +211,7 @@ class Dataset:
             sample_cnt=conf.bin_construct_sample_cnt, categorical=cats,
             use_missing=conf.use_missing, zero_as_missing=conf.zero_as_missing,
             seed=conf.data_random_seed, forced_bins=forced_bins)
+        distributed = False
         if sparse_in:
             if conf.num_machines > 1:
                 from .parallel.mesh import init_distributed
@@ -225,7 +226,6 @@ class Dataset:
             mappers = find_bin_mappers_sparse(raw, **bin_kw)
             binned = bin_data_sparse(raw, mappers)
         else:
-            distributed = False
             if conf.num_machines > 1:
                 from .parallel.mesh import init_distributed
                 init_distributed(conf)
@@ -242,7 +242,14 @@ class Dataset:
         self.mappers = binned.mappers
         self.feature_map = binned.feature_map
         self.bundle_meta = None
-        if conf.enable_bundle and binned.bins.shape[1] >= 3:
+        if distributed and conf.enable_bundle and binned.bins.shape[1] >= 3:
+            # the greedy bundle plan depends on rank-LOCAL conflict counts —
+            # divergent plans across ranks would give different grower
+            # feature spaces and silently corrupt the histogram psum
+            log.warning("EFB bundling is disabled under distributed bin "
+                        "finding (rank-local conflict counts would produce "
+                        "divergent bundle plans)")
+        elif conf.enable_bundle and binned.bins.shape[1] >= 3:
             from .efb import apply_bundles, plan_bundles
             # monotone-constrained features must keep their own columns: the
             # bundle candidate plane does not implement direction filtering
@@ -403,8 +410,9 @@ class Dataset:
             ds.weight = jnp.take(jnp.asarray(self.weight), idx_dev)
         if self.group is not None:
             # row subsetting cannot preserve arbitrary query boundaries
-            # (reference subset requires sorted whole groups); cv() splits by
-            # whole queries before calling subset
+            # (reference subset requires sorted whole groups); callers doing
+            # ranking must re-set group sizes on the subset themselves —
+            # cv() refuses ranking objectives outright (engine.cv)
             log.warning("Dataset.subset on grouped (ranking) data drops the "
                         "group boundaries unless rows cover whole queries in "
                         "order; re-set group on the subset if needed")

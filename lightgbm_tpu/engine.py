@@ -227,6 +227,14 @@ def cv(params: Dict[str, Any], train_set: Dataset, num_boost_round: int = 100,
     conf = params_to_config(params)
     if conf.num_iterations != 100 and num_boost_round == 100:
         num_boost_round = conf.num_iterations
+    if conf.objective in ("lambdarank", "rank_xendcg"):
+        # row-based folds cannot split whole queries and subset() drops group
+        # boundaries (reference cv handles groups in _make_n_folds; not
+        # implemented here — refuse loudly rather than fatal deep inside
+        # LambdaRank.init)
+        log.fatal("cv() does not support ranking objectives: fold rows "
+                  "cannot preserve query boundaries; split queries manually "
+                  "and call train() per fold")
     train_set.construct()
     label = np.asarray(train_set.label)
     n = train_set.num_data

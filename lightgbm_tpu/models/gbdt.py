@@ -99,6 +99,25 @@ class GBDT:
                             f"{config.grow_policy}")
             quant_on = False
         cegb_coupled_v, cegb_lazy_v = self._cegb_setup(config, train_set)
+        # HistogramPool analog (feature_histogram.hpp:687): histogram_pool_size
+        # MB -> cached-leaf-histogram budget; honored by the lossguide grower
+        hist_pool = 0
+        if config.histogram_pool_size > 0:
+            per_leaf = 3 * train_set.num_features * B * 4
+            cap = int(config.histogram_pool_size * (1 << 20)
+                      // max(1, per_leaf))
+            if cap < config.num_leaves:
+                if config.grow_policy == "depthwise":
+                    log.warning(
+                        f"histogram_pool_size={config.histogram_pool_size}MB "
+                        f"caps {cap} leaf histograms < num_leaves="
+                        f"{config.num_leaves}; only grow_policy=lossguide "
+                        "honors the pool — the depthwise frontier state is "
+                        "whole-level by design")
+                else:
+                    hist_pool = max(2, cap)
+                    log.info(f"histogram pool: {hist_pool} cached leaf "
+                             f"histograms (evicted parents rebuild)")
         self.gp = GrowParams(
             num_leaves=config.num_leaves,
             max_depth=config.max_depth,
@@ -127,6 +146,7 @@ class GBDT:
                           else 0),
             ff_bynode=(config.feature_fraction_bynode
                        if config.grow_policy == "depthwise" else 1.0),
+            hist_pool=hist_pool,
         )
         if (config.feature_fraction_bynode < 1.0
                 and config.grow_policy != "depthwise"):

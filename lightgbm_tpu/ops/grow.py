@@ -45,6 +45,13 @@ class GrowParams:
     # serial_tree_learner.cpp:397+) — per-LEVEL per-leaf resampling in the
     # depthwise grower; 1.0 = off
     ff_bynode: float = 1.0
+    # HistogramPool analog (reference: histogram_pool_size MB bounding the
+    # per-leaf histogram cache, feature_histogram.hpp:687): number of cached
+    # leaf histograms in the lossguide grower; 0 = unbounded ([L] resident).
+    # Evicted parents are rebuilt with one extra masked histogram pass —
+    # the reference's pool-miss ConstructHistograms, traded exactly the same
+    # way (memory for recompute)
+    hist_pool: int = 0
     # Data-parallel axis (reference: DataParallelTreeLearner,
     # data_parallel_tree_learner.cpp:149-240). When set, rows are sharded over this
     # mesh axis under shard_map and every histogram / root-sum is psum-ed — the
@@ -84,7 +91,11 @@ class TreeArrays(NamedTuple):
 
 class _GrowState(NamedTuple):
     leaf_id: jnp.ndarray         # [N] i32
-    hist: jnp.ndarray            # [L, 3, F, B]
+    hist: jnp.ndarray            # [P, 3, F, B] (P = L unless gp.hist_pool)
+    slot_of_leaf: jnp.ndarray    # [L] i32 pool slot per leaf (-1 evicted);
+                                 # [1] dummy when unpooled
+    leaf_of_slot: jnp.ndarray    # [P] i32 (or [1] dummy)
+    slot_age: jnp.ndarray        # [P] i32 last-write step (LRU; [1] dummy)
     leaf_g: jnp.ndarray          # [L]
     leaf_h: jnp.ndarray
     leaf_cnt: jnp.ndarray
@@ -162,9 +173,23 @@ def grow_tree(bins: jnp.ndarray, g: jnp.ndarray, h: jnp.ndarray, c: jnp.ndarray,
         is_cat=tile(best0.is_cat, False),
         cat_member=jnp.zeros((L, B), dtype=bool).at[0].set(best0.cat_member))
 
-    hist = jnp.zeros((L, 3, f, B), dtype=jnp.float32).at[0].set(hist0)
+    # HistogramPool (reference: feature_histogram.hpp:687): cap the cached
+    # leaf histograms at P slots; evicted parents rebuild with a masked pass
+    P = gp.hist_pool if 0 < gp.hist_pool < L else L
+    pooled = P < L
+    hist = jnp.zeros((P, 3, f, B), dtype=jnp.float32).at[0].set(hist0)
+    if pooled:
+        slot_of_leaf = jnp.full(L, -1, jnp.int32).at[0].set(0)
+        leaf_of_slot = jnp.full(P, -1, jnp.int32).at[0].set(0)
+        slot_age = jnp.zeros(P, jnp.int32)
+    else:
+        slot_of_leaf = jnp.zeros(1, jnp.int32)
+        leaf_of_slot = jnp.zeros(1, jnp.int32)
+        slot_age = jnp.zeros(1, jnp.int32)
     state = _GrowState(
         leaf_id=leaf_id, hist=hist,
+        slot_of_leaf=slot_of_leaf, leaf_of_slot=leaf_of_slot,
+        slot_age=slot_age,
         leaf_g=jnp.zeros(L).at[0].set(g0),
         leaf_h=jnp.zeros(L).at[0].set(h0),
         leaf_cnt=jnp.zeros(L).at[0].set(c0),
@@ -214,11 +239,52 @@ def grow_tree(bins: jnp.ndarray, g: jnp.ndarray, h: jnp.ndarray, c: jnp.ndarray,
                 H.hist_leaf(bins, g * mask, h * mask, c * mask, B, gp.hist_impl,
                             bins_T=bins_T),
                 gp)
-            hist_parent = st.hist[l]
+            if pooled:
+                # pool lookup; on miss rebuild the parent with one masked
+                # pass over the PRE-split membership (reference: HistogramPool
+                # miss -> ConstructHistograms)
+                slot_p = st.slot_of_leaf[l]
+                present = slot_p >= 0
+
+                def _read(_):
+                    return st.hist[jnp.maximum(slot_p, 0)]
+
+                def _rebuild(_):
+                    m2 = (st.leaf_id == l).astype(g.dtype)
+                    return _psum(H.hist_leaf(bins, g * m2, h * m2, c * m2, B,
+                                             gp.hist_impl, bins_T=bins_T), gp)
+
+                hist_parent = jax.lax.cond(present, _read, _rebuild, None)
+            else:
+                hist_parent = st.hist[l]
             hist_large = hist_parent - hist_small
             hist_left = jnp.where(small_is_left, hist_small, hist_large)
             hist_right = jnp.where(small_is_left, hist_large, hist_small)
-            hist2 = st.hist.at[l].set(hist_left).at[new_leaf].set(hist_right)
+            if pooled:
+                # LRU slot allocation: left child reuses the parent's slot
+                # when present; victims are the oldest-written slots
+                big = jnp.int32(1 << 30)
+                iota_p = jnp.arange(P)
+                age1 = jnp.where(iota_p == slot_p, big, st.slot_age)
+                vA = jnp.argmin(age1).astype(jnp.int32)
+                vB = jnp.argmin(age1.at[vA].set(big)).astype(jnp.int32)
+                slot_l = jnp.where(present, slot_p, vA)
+                slot_r = jnp.where(present, vA, vB)
+                old_l = st.leaf_of_slot[slot_l]
+                old_r = st.leaf_of_slot[slot_r]
+                iota_L = jnp.arange(L)
+                sol = jnp.where((iota_L == old_l) | (iota_L == old_r), -1,
+                                st.slot_of_leaf)
+                sol = sol.at[l].set(slot_l).at[new_leaf].set(slot_r)
+                hist2 = st.hist.at[slot_l].set(hist_left) \
+                               .at[slot_r].set(hist_right)
+                los = st.leaf_of_slot.at[slot_l].set(l) \
+                                     .at[slot_r].set(new_leaf)
+                ages = st.slot_age.at[slot_l].set(t + 1).at[slot_r].set(t + 1)
+            else:
+                hist2 = st.hist.at[l].set(hist_left).at[new_leaf].set(hist_right)
+                sol, los, ages = (st.slot_of_leaf, st.leaf_of_slot,
+                                  st.slot_age)
 
             # ---- tree arrays (node t) ----
             tr = st.tree
@@ -291,6 +357,7 @@ def grow_tree(bins: jnp.ndarray, g: jnp.ndarray, h: jnp.ndarray, c: jnp.ndarray,
 
             return _GrowState(
                 leaf_id=leaf_id2, hist=hist2,
+                slot_of_leaf=sol, leaf_of_slot=los, slot_age=ages,
                 leaf_g=st.leaf_g.at[l].set(lg).at[new_leaf].set(rg),
                 leaf_h=st.leaf_h.at[l].set(lh).at[new_leaf].set(rh),
                 leaf_cnt=st.leaf_cnt.at[l].set(lc).at[new_leaf].set(rc),

@@ -172,12 +172,18 @@ def grow_tree_depthwise(bins: jnp.ndarray, g: jnp.ndarray, h: jnp.ndarray,
         # (tree seed, level) so trees and levels decorrelate) ----
         search_mask = feature_mask & st.vote_mask
         if gp.ff_bynode < 1.0:
-            kf = max(1, int(round(f * gp.ff_bynode)))
+            # Bernoulli(ff_bynode) keep within the CURRENTLY-USABLE set (the
+            # reference samples exactly k of the per-tree used features,
+            # serial_tree_learner.cpp:397+; a global top-k over all F columns
+            # would compound with feature_fraction and can zero out a leaf's
+            # search set). The best-u usable feature is always kept so no
+            # leaf ever searches nothing.
             seed_base = qseed if qseed is not None else jnp.int32(0)
             key = jax.random.fold_in(jax.random.PRNGKey(seed_base), lvl)
             u = jax.random.uniform(key, (L, f))
-            thr = jax.lax.top_k(u, kf)[0][:, -1:]
-            search_mask = search_mask & (u >= thr)
+            u_allowed = jnp.where(search_mask, u, -1.0)
+            best = u_allowed >= u_allowed.max(axis=1, keepdims=True)
+            search_mask = search_mask & ((u < gp.ff_bynode) | best)
 
         # ---- CEGB penalty plane (DetlaGain, cegb hpp:51-62): recomputed
         # fresh each level from current bookkeeping, so a feature that became

@@ -1,0 +1,56 @@
+"""Micro-profiles of the Pallas histogram kernel at bench scale (real TPU).
+import sys; sys.path.insert(0, "/root/repo")
+Times the q8 kernel at S=1 and S=128, plus onehot-build variants, to locate
+the fixed per-level cost."""
+import functools
+import time
+
+import numpy as np
+import jax
+import jax.numpy as jnp
+from jax.experimental import pallas as pl
+from jax.experimental.pallas import tpu as pltpu
+
+N, F, B = 10_000_000, 28, 64
+rng = np.random.RandomState(0)
+bins_T = jax.device_put(rng.randint(0, B, size=(F, N)).astype(np.uint8))
+gq = jax.device_put(rng.randint(-127, 128, size=N).astype(np.int8))
+hq = jax.device_put(rng.randint(0, 128, size=N).astype(np.int8))
+cq = jax.device_put(np.ones(N, np.int8))
+
+
+def timeit(name, fn, *args, reps=5):
+    out = jax.block_until_ready(fn(*args))
+    t0 = time.time()
+    for _ in range(reps):
+        out = jax.block_until_ready(fn(*args))
+    dt = (time.time() - t0) / reps * 1000
+    print(f"{name}: {dt:.2f} ms")
+    return out
+
+
+from lightgbm_tpu.ops.pallas_hist import hist_pallas_q8, hist_pallas
+
+for S in (1, 16, 128):
+    slot = jax.device_put(rng.randint(0, S, size=N).astype(np.int32))
+    timeit(f"q8 S={S}", jax.jit(functools.partial(
+        hist_pallas_q8, num_slots=S, num_bins=B)),
+        bins_T, gq, hq, cq, slot, jnp.float32(127.0), jnp.float32(127.0))
+
+# variant: chunk 2048 and 512 at S=1 and S=128
+for chunk in (512, 2048, 4096):
+    for S in (1, 128):
+        slot = jax.device_put(rng.randint(0, S, size=N).astype(np.int32))
+        try:
+            timeit(f"q8 S={S} chunk={chunk}", jax.jit(functools.partial(
+                hist_pallas_q8, num_slots=S, num_bins=B, chunk=chunk)),
+                bins_T, gq, hq, cq, slot, jnp.float32(127.0),
+                jnp.float32(127.0))
+        except Exception as e:
+            print(f"q8 S={S} chunk={chunk}: FAIL {type(e).__name__}")
+
+# bf16 5-channel kernel for comparison at S=1
+g = jax.device_put(rng.randn(N).astype(np.float32))
+slot0 = jax.device_put(np.zeros(N, np.int32))
+timeit("bf16 S=1", jax.jit(functools.partial(
+    hist_pallas, num_slots=1, num_bins=B)), bins_T, g, g, g, slot0)
