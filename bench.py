@@ -133,23 +133,10 @@ def _phase_breakdown(booster, ds, n_rows, file):
     lid = jnp.zeros(n, jnp.int32)
     hist_state = jnp.zeros((L, 3, f, B), jnp.float32) + 1.0
 
-    def t_loop(name, op, *big, K=6):
-        # the large arrays are explicit jit ARGUMENTS — closing over a 10M-row
-        # device array would embed it as a constant in the compile payload
-        # (the tunneled compile service rejects those with HTTP 413)
-        def loop(k, x0, *a):
-            return jax.lax.fori_loop(
-                0, k, lambda i, acc: acc + op(acc * 0 + 1 + i * 1e-9, *a), x0)
-        f1 = jax.jit(_partial(loop, 1))
-        fK = jax.jit(_partial(loop, K))
-        x0 = jnp.zeros((), jnp.float32)
-        jax.block_until_ready(f1(x0, *big))
-        jax.block_until_ready(fK(x0, *big))
-        t0 = time.time(); jax.block_until_ready(f1(x0, *big))
-        t1 = time.time() - t0
-        t0 = time.time(); jax.block_until_ready(fK(x0, *big))
-        tK = time.time() - t0
-        print(f"# phase {name}: {(tK - t1) / (K - 1) * 1000:.2f} ms/op",
+    from lightgbm_tpu.utils.timer import time_op_in_jit
+
+    def t_loop(name, op, *big):
+        print(f"# phase {name}: {time_op_in_jit(op, *big):.2f} ms/op",
               file=file)
 
     t_loop("hist_root", lambda s, bb, bt, gg: HH.hist_leaf(

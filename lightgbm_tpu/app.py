@@ -52,12 +52,6 @@ def parse_args(argv: List[str]) -> Dict[str, str]:
 
 def _load_dataset(path: str, conf: Config, params: Dict, reference=None,
                   num_features_hint: int = 0) -> Dataset:
-    if conf.two_round:
-        # reference: TextReader two-phase loading for >RAM files
-        # (utils/text_reader.h); this loader reads the whole file into memory
-        log.warning("two_round loading is not implemented: the file is read "
-                    "into memory in one pass (use save_binary to avoid "
-                    "re-parsing large files)")
     # binary dataset cache (reference: auto-load of <data>.bin,
     # application.cpp LoadData + save_binary). Disabled for auto-partitioned
     # distributed runs: every rank would race-write its ROW SHARD to the same
@@ -75,7 +69,8 @@ def _load_dataset(path: str, conf: Config, params: Dict, reference=None,
                    weight_column=conf.weight_column,
                    group_column=conf.group_column,
                    ignore_column=conf.ignore_column,
-                   num_features_hint=num_features_hint)
+                   num_features_hint=num_features_hint,
+                   two_round=conf.two_round)
     X, label, weight, group, init = (pf.X, pf.label, pf.weight, pf.group,
                                      pf.init_score)
     if conf.num_machines > 1 and not conf.pre_partition and group is not None:
@@ -149,7 +144,8 @@ def run_predict(conf: Config, params: Dict) -> None:
                    label_column=conf.label_column,
                    weight_column=conf.weight_column,
                    group_column=conf.group_column,
-                   ignore_column=conf.ignore_column, num_features_hint=nf)
+                   ignore_column=conf.ignore_column, num_features_hint=nf,
+                   two_round=conf.two_round)
     X = pf.X
     if X.shape[1] < nf:  # file sparser than train data (LibSVM tail zeros)
         X = np.pad(X, ((0, 0), (0, nf - X.shape[1])))
@@ -179,7 +175,8 @@ def run_refit(conf: Config, params: Dict) -> None:
                    label_column=conf.label_column,
                    weight_column=conf.weight_column,
                    group_column=conf.group_column,
-                   ignore_column=conf.ignore_column, num_features_hint=nf)
+                   ignore_column=conf.ignore_column, num_features_hint=nf,
+                   two_round=conf.two_round)
     if pf.label is None:
         log.fatal("Refit requires labels in the data file")
     X = pf.X

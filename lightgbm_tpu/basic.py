@@ -343,7 +343,8 @@ class Dataset:
             "params": self.params,
             "pandas_categorical": self.pandas_categorical,
         }
-        with open(filename, "wb") as fh:
+        from .io.vfs import open_file
+        with open_file(filename, "wb") as fh:
             pickle.dump(payload, fh)
         log.info(f"Saved binned dataset to {filename}")
         return self
@@ -351,7 +352,8 @@ class Dataset:
     @staticmethod
     def load_binary(filename: str, params=None) -> "Dataset":
         import pickle
-        with open(filename, "rb") as fh:
+        from .io.vfs import open_file
+        with open_file(filename, "rb") as fh:
             payload = pickle.load(fh)
         if payload.get("magic") != Dataset._BIN_MAGIC:
             log.fatal(f"{filename} is not a lightgbm_tpu binary dataset")

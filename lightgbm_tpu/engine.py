@@ -227,7 +227,8 @@ def cv(params: Dict[str, Any], train_set: Dataset, num_boost_round: int = 100,
     conf = params_to_config(params)
     if conf.num_iterations != 100 and num_boost_round == 100:
         num_boost_round = conf.num_iterations
-    if conf.objective in ("lambdarank", "rank_xendcg"):
+    if conf.objective in ("lambdarank", "rank_xendcg", "xendcg", "xe_ndcg",
+                          "xe_ndcg_mart", "rank_xendcg_mart"):
         # row-based folds cannot split whole queries and subset() drops group
         # boundaries (reference cv handles groups in _make_n_folds; not
         # implemented here — refuse loudly rather than fatal deep inside

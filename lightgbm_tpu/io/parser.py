@@ -20,6 +20,7 @@ Sidecar files follow the reference conventions (src/io/metadata.cpp:473-560):
 from __future__ import annotations
 
 import os
+import re
 from typing import List, Optional, Tuple
 
 import numpy as np
@@ -48,8 +49,9 @@ def detect_format(path: str, skip_header: bool = False) -> Tuple[str, str]:
     are ``idx:value`` pairs is LibSVM; otherwise the delimiter with the most
     consistent column count wins.
     """
+    from .vfs import open_text
     lines: List[str] = []
-    with open(path, "r") as fh:
+    with open_text(path) as fh:
         for raw in fh:
             s = raw.strip()
             if s:
@@ -137,54 +139,147 @@ class ParsedFile:
 def _load_sidecars(path: str):
     """Reference conventions: <file>.weight / .query / .init sidecar files
     (metadata.cpp:473 LoadWeights, :500 LoadQueryBoundaries, :521 LoadInitialScore)."""
+    from .vfs import exists, open_file
     weight = group = init = None
     wpath = path + ".weight"
-    if os.path.exists(wpath):
-        weight = np.loadtxt(wpath, dtype=np.float64).reshape(-1)
+    if exists(wpath):
+        with open_file(wpath, "rb") as fh:
+            weight = np.loadtxt(fh, dtype=np.float64).reshape(-1)
         log.info(f"Loading weights from {wpath}")
     qpath = path + ".query"
-    if os.path.exists(qpath):
-        group = np.loadtxt(qpath, dtype=np.int64).reshape(-1)
+    if exists(qpath):
+        with open_file(qpath, "rb") as fh:
+            group = np.loadtxt(fh, dtype=np.int64).reshape(-1)
         log.info(f"Loading query boundaries from {qpath}")
     ipath = path + ".init"
-    if os.path.exists(ipath):
-        init = np.loadtxt(ipath, dtype=np.float64)
+    if exists(ipath):
+        with open_file(ipath, "rb") as fh:
+            init = np.loadtxt(fh, dtype=np.float64)
         log.info(f"Loading initial scores from {ipath}")
     return weight, group, init
 
 
+def _stream_line_chunks(path: str, chunk_bytes: int = 64 << 20):
+    """Yield byte chunks ending on line boundaries (partial tail carried
+    over) — the streaming primitive for two-round loading."""
+    from .vfs import open_file
+    carry = b""
+    with open_file(path, "rb") as fh:
+        while True:
+            block = fh.read(chunk_bytes)
+            if not block:
+                break
+            buf = carry + block
+            cut = buf.rfind(b"\n")
+            if cut < 0:
+                carry = buf
+                continue
+            yield buf[: cut + 1]
+            carry = buf[cut + 1:]
+    if carry.strip():
+        yield carry
+
+
+def _load_delimited_two_round(path: str, delim: str, header: bool
+                              ) -> np.ndarray:
+    """Two-phase delimited load (reference: TextReader two-phase,
+    utils/text_reader.h + two_round config): pass 1 counts rows/columns,
+    pass 2 parses chunk-by-chunk into the preallocated matrix — peak memory
+    is the f64 matrix plus ONE text chunk, not text + matrix together."""
+    from ..native import get_lib, parse_delimited
+    n_rows = 0
+    ncol = 0
+    first = True
+    blank_re = re.compile(rb"(?:^|\n)[ \t\r]*(?:\n|$)")
+    for chunk in _stream_line_chunks(path):
+        if first:
+            line = chunk.split(b"\n", 1)[0]
+            ncol = line.count(delim.encode()) + 1
+            first = False
+        # fast path: newline count (+1 for a final unterminated line);
+        # exact per-line scan only for chunks that contain blank lines
+        if blank_re.search(chunk):
+            n_rows += sum(1 for ln in chunk.splitlines() if ln.strip())
+        else:
+            n_rows += chunk.count(b"\n") + (not chunk.endswith(b"\n"))
+    if header:
+        n_rows -= 1
+    if n_rows <= 0 or ncol <= 0:
+        log.fatal(f"Data file {path} has no data rows")
+    out = np.empty((n_rows, ncol), dtype=np.float64)
+    row = 0
+    skip_first = header
+    for chunk in _stream_line_chunks(path):
+        part = parse_delimited(chunk, delim, skip_first=skip_first)
+        if part is None:  # no native toolchain: python per-chunk fallback
+            lines = [ln for ln in chunk.decode("utf-8", "replace").splitlines()
+                     if ln.strip()]
+            if skip_first and lines:
+                lines = lines[1:]
+            part = np.empty((len(lines), ncol), dtype=np.float64)
+            for i, ln in enumerate(lines):
+                toks = ln.rstrip("\r").split(delim)
+                if len(toks) != ncol:
+                    log.fatal(f"{path}: row has {len(toks)} columns, "
+                              f"expected {ncol}")
+                for j, t in enumerate(toks):
+                    part[i, j] = _to_float(t)
+        skip_first = False
+        if part.shape[0]:
+            if part.shape[1] != ncol:
+                log.fatal(f"{path}: chunk with {part.shape[1]} columns, "
+                          f"expected {ncol}")
+            out[row: row + part.shape[0]] = part
+            row += part.shape[0]
+    if row != n_rows:
+        log.fatal(f"{path}: two-round pass mismatch ({row} vs {n_rows} rows)")
+    return out
+
+
 def load_file(path: str, header: bool = False, label_column: str = "",
               weight_column: str = "", group_column: str = "",
-              ignore_column: str = "", num_features_hint: int = 0
-              ) -> ParsedFile:
+              ignore_column: str = "", num_features_hint: int = 0,
+              two_round: bool = False) -> ParsedFile:
     """Load a CSV/TSV/LibSVM data file with column roles.
 
     Defaults mirror the reference (config.h label_column docs): label is
     column 0 of the used columns unless specified; LibSVM labels are the
     leading bare token of each row.
     """
-    if not os.path.exists(path):
+    from .vfs import exists as _vfs_exists
+    if not _vfs_exists(path):
         log.fatal(f"Data file {path} does not exist")
     kind, delim = detect_format(path, skip_header=header)
 
     sw, sg, si = _load_sidecars(path)
 
     if kind == "libsvm":
+        if two_round:
+            log.warning("two_round streaming is implemented for delimited "
+                        "files only; the LibSVM path loads in one pass")
         X, y = _load_libsvm(path, num_features_hint)
         return ParsedFile(X, y, sw, sg, si, None)
 
     header_names: Optional[List[str]] = None
     if header:
-        with open(path, "r") as fh:
+        from .vfs import open_text
+        with open_text(path) as fh:
             first_line = fh.readline().rstrip("\n\r")
         header_names = [t.strip() for t in first_line.split(delim)]
 
-    # native multithreaded parser (native/fastio.cpp, the analog of the
-    # reference's C++ CSVParser/TSVParser); NumPy/Python fallback below
-    from ..native import parse_delimited
-    with open(path, "rb") as fh:
-        raw_bytes = fh.read()
-    mat = parse_delimited(raw_bytes, delim, skip_first=bool(header))
+    if two_round:
+        # streaming two-phase load (reference: TextReader two-phase +
+        # two_round config): the raw text never sits fully in RAM
+        mat = _load_delimited_two_round(path, delim, bool(header))
+        raw_bytes = b""
+    else:
+        # native multithreaded parser (native/fastio.cpp, the analog of the
+        # reference's C++ CSVParser/TSVParser); NumPy/Python fallback below
+        from ..native import parse_delimited
+        from .vfs import open_file
+        with open_file(path, "rb") as fh:
+            raw_bytes = fh.read()
+        mat = parse_delimited(raw_bytes, delim, skip_first=bool(header))
     if mat is None:
         rows: List[List[str]] = []
         first = True
@@ -247,7 +342,8 @@ def _load_libsvm(path: str, num_features_hint: int = 0
     """LibSVM rows: ``label idx:val idx:val ...`` (0- or 1-based indices kept
     as-is, matching the reference's zero_as_missing-friendly dense fill)."""
     from ..native import parse_libsvm
-    with open(path, "rb") as fh:
+    from .vfs import open_file
+    with open_file(path, "rb") as fh:
         raw_bytes = fh.read()
     res = parse_libsvm(raw_bytes, num_features_hint)
     if res is not None:
@@ -255,7 +351,8 @@ def _load_libsvm(path: str, num_features_hint: int = 0
     labels: List[float] = []
     entries: List[List[Tuple[int, float]]] = []
     max_idx = -1
-    with open(path, "r") as fh:
+    from .vfs import open_text
+    with open_text(path) as fh:
         for raw in fh:
             s = raw.strip()
             if not s:

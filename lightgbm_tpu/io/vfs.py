@@ -1,0 +1,62 @@
+"""Virtual file access (reference: VirtualFileReader/Writer, utils/file_io.h +
+src/io/file_io.cpp:57 kHdfsProto).
+
+The reference abstracts file IO behind a scheme-dispatched reader/writer so an
+HDFS build can swap transports. Same seam here: ``register_scheme`` installs
+an opener for a URI scheme ("hdfs", "gs", ...); local paths use plain open().
+No remote transport ships in-tree (this environment has none to test
+against), but the extension point is real: an opener returns a file-like
+object and every loader/cache path in the package goes through it.
+"""
+from __future__ import annotations
+
+from typing import Callable, Dict
+
+from ..utils import log
+
+_OPENERS: Dict[str, Callable] = {}
+
+
+def register_scheme(scheme: str, opener: Callable) -> None:
+    """Install ``opener(path, mode) -> file-like`` for ``scheme://`` paths."""
+    _OPENERS[scheme.lower()] = opener
+
+
+def _scheme_of(path: str) -> str:
+    head, sep, _ = path.partition("://")
+    return head.lower() if sep else ""
+
+
+def open_file(path: str, mode: str = "rb"):
+    """Open ``path`` through the scheme registry (local files directly)."""
+    scheme = _scheme_of(path)
+    if not scheme:
+        return open(path, mode)
+    opener = _OPENERS.get(scheme)
+    if opener is None:
+        log.fatal(f"no file handler registered for '{scheme}://' paths "
+                  f"(register one with lightgbm_tpu.io.vfs.register_scheme; "
+                  "the reference's HDFS support is likewise a compile-time "
+                  "opt-in, file_io.cpp:57)")
+    return opener(path, mode)
+
+
+def open_text(path: str, encoding: str = "utf-8"):
+    """Text-mode open through the scheme registry."""
+    scheme = _scheme_of(path)
+    if not scheme:
+        return open(path, "r", encoding=encoding, errors="replace")
+    import io as _io
+    return _io.TextIOWrapper(open_file(path, "rb"), encoding=encoding,
+                             errors="replace")
+
+
+def exists(path: str) -> bool:
+    if _scheme_of(path):
+        try:
+            with open_file(path, "rb"):
+                return True
+        except Exception:
+            return False
+    import os
+    return os.path.exists(path)

@@ -147,6 +147,7 @@ class GBDT:
             ff_bynode=(config.feature_fraction_bynode
                        if config.grow_policy == "depthwise" else 1.0),
             hist_pool=hist_pool,
+            packed=str(config.packed_levels).lower() in ("true", "1"),
         )
         if (config.feature_fraction_bynode < 1.0
                 and config.grow_policy != "depthwise"):

@@ -52,6 +52,13 @@ class GrowParams:
     # the reference's pool-miss ConstructHistograms, traded exactly the same
     # way (memory for recompute)
     hist_pool: int = 0
+    # segment-packed depthwise levels (reference: DataPartition's
+    # partition-ordered rows, data_partition.hpp:113): rows kept in
+    # leaf-segment order; each level gathers only the smaller children into a
+    # chunk-aligned buffer and the packed kernel accumulates per-chunk slots —
+    # level cost stops scaling with frontier width. Serial + quantized +
+    # pallas path only (the grower falls back silently otherwise)
+    packed: bool = False
     # Data-parallel axis (reference: DataParallelTreeLearner,
     # data_parallel_tree_learner.cpp:149-240). When set, rows are sharded over this
     # mesh axis under shard_map and every histogram / root-sum is psum-ed — the

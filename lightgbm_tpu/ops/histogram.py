@@ -392,12 +392,14 @@ def pick_impl(requested: str, backend: Optional[str] = None) -> str:
 
 def hist_leaf(bins, g, h, c, num_bins, impl="auto", bins_T=None, quant=None):
     impl = pick_impl(impl)
+    interp = jax.default_backend() == "cpu"   # tests force impl=pallas on CPU
     if quant is not None and impl == "pallas":
         from .pallas_hist import hist_pallas_q8
         bt = bins_T if bins_T is not None else bins.T
         slot = jnp.zeros(bins.shape[0], jnp.int32)
         return hist_pallas_q8(bt, quant.gq, quant.hq, quant.cq, slot, 1,
-                              num_bins, quant.scale_g, quant.scale_h)[0]
+                              num_bins, quant.scale_g, quant.scale_h,
+                              interpret=interp)[0]
     if quant is not None:
         # non-pallas backends: dequantize per row (same numbers the int32
         # accumulator would produce, up to f32 summation order)
@@ -409,7 +411,7 @@ def hist_leaf(bins, g, h, c, num_bins, impl="auto", bins_T=None, quant=None):
     if impl == "pallas":
         from .pallas_hist import hist_leaf_pallas
         bt = bins_T if bins_T is not None else bins.T
-        return hist_leaf_pallas(bt, g, h, c, num_bins)
+        return hist_leaf_pallas(bt, g, h, c, num_bins, interpret=interp)
     return hist_leaf_onehot(bins, g, h, c, num_bins)
 
 
@@ -438,10 +440,12 @@ def hist_routed(bins, g, h, c, leaf_id, tables, na_bin, num_slots, num_bins,
     if impl == "pallas":
         from .pallas_hist import (hist_pallas, hist_pallas_q8,
                                   route_level_pallas)
+        interp = jax.default_backend() == "cpu"
         bt = bins_T if bins_T is not None else bins.T
         if bins.shape[1] <= 512:
             slot, lid2 = route_level_pallas(bt, leaf_id, tables, na_bin,
-                                            num_slots, tables.feat.shape[0])
+                                            num_slots, tables.feat.shape[0],
+                                            interpret=interp)
         else:
             # wide data: the route kernel's [F, chunk] block would exhaust
             # VMEM; fall back to the XLA gather route (EFB bundling keeps
@@ -450,7 +454,8 @@ def hist_routed(bins, g, h, c, leaf_id, tables, na_bin, num_slots, num_bins,
         if quant is not None:
             return hist_pallas_q8(bt, quant.gq, quant.hq, quant.cq, slot,
                                   num_slots, num_bins, quant.scale_g,
-                                  quant.scale_h), lid2
-        return hist_pallas(bt, g, h, c, slot, num_slots, num_bins), lid2
+                                  quant.scale_h, interpret=interp), lid2
+        return hist_pallas(bt, g, h, c, slot, num_slots, num_bins,
+                           interpret=interp), lid2
     return hist_routed_onehot(bins, g, h, c, leaf_id, tables, na_bin,
                               num_slots, num_bins)

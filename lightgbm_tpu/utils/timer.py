@@ -90,3 +90,39 @@ def timed(name: str, block: bool = False):
         inner.__doc__ = fn.__doc__
         return inner
     return wrap
+
+
+def time_op_in_jit(op, *big, K: int = 6, reps: int = 1):
+    """Device time of ``op(s, *big)`` measured INSIDE one jit: cost =
+    (t_K - t_1) / (K - 1) over a fori_loop, so tunneled-runtime dispatch
+    latency cancels. ``op`` must make its output genuinely depend on the
+    traced loop value ``s`` (e.g. scale a float operand by it, or fold it
+    into an index with a non-constant-foldable min/remainder) — otherwise
+    XLA hoists the op out of the loop and the measurement reads ~0. The
+    large arrays MUST be passed via ``*big`` (closure constants are embedded
+    in the compile payload, which the tunneled compile service caps).
+    Returns milliseconds per op. Shared by bench.py's phase breakdown and
+    the scripts/profile_* tools."""
+    import time as _time
+    from functools import partial as _partial
+    import jax
+    import jax.numpy as jnp
+
+    def loop(k, x0, *a):
+        return jax.lax.fori_loop(
+            0, k, lambda i, acc: acc + op(acc * 0 + 1 + i, *a), x0)
+
+    f1 = jax.jit(_partial(loop, 1))
+    fK = jax.jit(_partial(loop, K))
+    x0 = jnp.zeros((), jnp.float32)
+    jax.block_until_ready(f1(x0, *big))
+    jax.block_until_ready(fK(x0, *big))
+    best = None
+    for _ in range(reps):
+        t0 = _time.time(); jax.block_until_ready(f1(x0, *big))
+        t1 = _time.time() - t0
+        t0 = _time.time(); jax.block_until_ready(fK(x0, *big))
+        tK = _time.time() - t0
+        ms = (tK - t1) / (K - 1) * 1000.0
+        best = ms if best is None else min(best, ms)
+    return best

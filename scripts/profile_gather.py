@@ -1,0 +1,47 @@
+"""Feasibility probe for segment-packed levels: cost of the [F, N] bin-matrix
+gather along N (packed reorder) and 1-D channel gathers at 10M rows."""
+import sys
+sys.path.insert(0, "/root/repo")
+import functools, time
+import numpy as np, jax, jax.numpy as jnp
+
+N, F = 10_000_000, 28
+M = N // 2
+rng = np.random.RandomState(0)
+bins_T = jax.device_put(rng.randint(0, 64, size=(F, N)).astype(np.uint8))
+bins_NF = jax.device_put(rng.randint(0, 64, size=(N, F)).astype(np.uint8))
+# blocky permutation (segments preserved) — the realistic case
+blocks = np.arange(9998336).reshape(-1, 4096)
+order = blocks[rng.permutation(blocks.shape[0])].ravel()[:M]
+idx = jax.device_put(order.astype(np.int32))
+gq = jax.device_put(rng.randint(-127, 128, size=N).astype(np.int8))
+
+def t_loop(name, op, *big, K=48):
+    def loop(k, x0, *a):
+        return jax.lax.fori_loop(0, k, lambda i, acc: acc + op(acc.astype(jnp.int32), *a), x0)
+    f1 = jax.jit(functools.partial(loop, 1)); fK = jax.jit(functools.partial(loop, K))
+    x0 = jnp.zeros((), jnp.float32)
+    jax.block_until_ready(f1(x0, *big)); jax.block_until_ready(fK(x0, *big))
+    t0=time.time(); jax.block_until_ready(f1(x0, *big)); t1=time.time()-t0
+    t0=time.time(); jax.block_until_ready(fK(x0, *big)); tK=time.time()-t0
+    print(f"{name}: {(tK-t1)/(K-1)*1000:.2f} ms")
+
+# [F, N] gather along axis 1 (what the packed kernel input build needs)
+t_loop("take bins_T axis1 (M=N/2)", lambda s, bt, ix: jnp.take(
+    bt, jnp.remainder(ix + s, jnp.int32(9_000_000)), axis=1).astype(jnp.int32).sum(),
+    bins_T, idx)
+# row-major [N, F] gather along axis 0 (alternative layout)
+t_loop("take bins_NF axis0 (M=N/2)", lambda s, b, ix: jnp.take(
+    b, jnp.remainder(ix + s, jnp.int32(9_000_000)), axis=0).astype(jnp.int32).sum(),
+    bins_NF, idx)
+# 1-D int8 channel gather
+t_loop("take gq 1d (M=N/2)", lambda s, g, ix: jnp.take(
+    g, jnp.remainder(ix + s, jnp.int32(9_000_000))).astype(jnp.int32).sum(), gq, idx)
+# [N] i32 scatter (permutation write)
+src = jax.device_put(np.arange(N, dtype=np.int32))
+perm = jax.device_put(rng.permutation(N).astype(np.int32))
+t_loop("scatter perm [N] i32", lambda s, p, x: jnp.zeros(N, jnp.int32)
+       .at[jnp.remainder(p + s, jnp.int32(N))].set(x).sum(), perm, src)
+# [N] cumsum
+gf = jax.device_put(rng.rand(N).astype(np.float32))
+t_loop("cumsum [N] f32", lambda s, g: jnp.cumsum(g * s).sum()*0 + jnp.cumsum(g*s)[-1], gf)

@@ -167,3 +167,47 @@ def test_native_libsvm_tabs(tmp_path):
     np.testing.assert_array_equal(pf_native.X, pf_py.X)
     assert pf_native.X.shape == (3, 8)
     assert pf_native.X[0, 2] == 3.5 and pf_native.X[0, 7] == 1.25
+
+
+def test_two_round_streaming_matches_one_pass(tmp_path):
+    """two_round chunked loading (reference: TextReader two-phase,
+    utils/text_reader.h) must produce the exact same matrix as the
+    whole-buffer path, across chunk boundaries."""
+    import lightgbm_tpu.io.parser as P
+    rng = np.random.RandomState(5)
+    X = rng.randn(5000, 7)
+    X[rng.rand(5000) < 0.05, 2] = np.nan
+    y = rng.randint(0, 2, 5000)
+    path = tmp_path / "data.csv"
+    np.savetxt(path, np.column_stack([y, X]), delimiter=",", fmt="%.9g")
+    one = P.load_file(str(path))
+    # tiny chunks force many boundary carries
+    orig = P._stream_line_chunks
+    P._stream_line_chunks = lambda p, chunk_bytes=4096: orig(p, 4096)
+    try:
+        two = P.load_file(str(path), two_round=True)
+    finally:
+        P._stream_line_chunks = orig
+    np.testing.assert_array_equal(one.label, two.label)
+    np.testing.assert_array_equal(one.X, two.X)
+
+
+def test_vfs_scheme_registry(tmp_path):
+    """VirtualFile abstraction (reference: utils/file_io.h): a registered
+    scheme serves file bytes; unregistered schemes fail loudly."""
+    import io as _io
+    from lightgbm_tpu.io import vfs
+    payload = b"1,0.5,2.0\n0,0.1,3.5\n"
+
+    def opener(path, mode):
+        assert path.startswith("mem://")
+        return _io.BytesIO(payload)
+
+    vfs.register_scheme("mem", opener)
+    try:
+        with vfs.open_file("mem://whatever", "rb") as fh:
+            assert fh.read() == payload
+        with pytest.raises(Exception):
+            vfs.open_file("hdfs://nope/x", "rb")
+    finally:
+        vfs._OPENERS.pop("mem", None)
