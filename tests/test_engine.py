@@ -363,3 +363,25 @@ def test_histogram_pool_bounded_cache():
     auc_p = roc_auc_score(y, pooled.predict(X))
     assert abs(auc_b - auc_p) < 0.02, (auc_b, auc_p)
     assert auc_p > 0.9
+
+
+def test_trailing_stumps_never_reach_saved_model(tmp_path):
+    """The fused path's lagged finished-check queues single-leaf trees for up
+    to 8 iterations; if num_boost_round ends first, finish_training must drop
+    them so saved models match the reference's stop-without-adding behavior
+    (gbdt.cpp:430; round-2 ADVICE finding)."""
+    rng = np.random.RandomState(31)
+    X = rng.randn(300, 4)
+    y = rng.permutation(np.arange(300) % 2).astype(float)  # pure noise labels
+    # min_gain huge -> no split is ever worth it after the first few
+    bst = lgb.train({**_P, "objective": "binary", "min_gain_to_split": 1e9},
+                    lgb.Dataset(X, label=y), num_boost_round=5)
+    trees = bst._ensure_host_trees()
+    # every tree left in the model must have at least one real split OR the
+    # model is empty — no trailing stump may survive finalize
+    assert all(t.num_leaves > 1 for t in trees) or not trees
+    path = str(tmp_path / "m.txt")
+    bst.save_model(path)
+    loaded = lgb.Booster(model_file=path)
+    assert all(t.num_leaves > 1 for t in loaded._ensure_host_trees()) \
+        or not loaded._ensure_host_trees()
