@@ -1,0 +1,70 @@
+"""CLI <-> Python consistency over the reference's example configs
+(reference: tests/python_package_test/test_consistency.py:41-60 — train via
+Python with the example train.conf params and assert predictions match the
+CLI's result files; the examples double as fixtures, SURVEY.md §4)."""
+import os
+
+import numpy as np
+import pytest
+
+import lightgbm_tpu as lgb
+from lightgbm_tpu.app import main, parse_args
+from lightgbm_tpu.config import Config
+from lightgbm_tpu.io.parser import load_file
+
+REF = "/root/reference/examples"
+
+CASES = [
+    ("binary_classification", "binary.train", "binary.test"),
+    ("regression", "regression.train", "regression.test"),
+    ("multiclass_classification", "multiclass.train", "multiclass.test"),
+    ("lambdarank", "rank.train", "rank.test"),
+    ("xendcg", "rank.train", "rank.test"),
+]
+
+
+@pytest.mark.parametrize("example,train_file,test_file",
+                         CASES, ids=[c[0] for c in CASES])
+def test_cli_matches_python_on_example_config(example, train_file, test_file,
+                                              tmp_path):
+    d = f"{REF}/{example}"
+    if not os.path.exists(f"{d}/train.conf"):
+        pytest.skip(f"{example} config unavailable")
+    rounds = 5
+    overrides = [f"config={d}/train.conf", f"data={d}/{train_file}",
+                 f"num_trees={rounds}", "verbosity=-1", "metric_freq=0"]
+
+    # ---- CLI train -> model file; CLI predict -> result file ----
+    model = tmp_path / "cli_model.txt"
+    # drop the valid set for speed; keep everything else from the conf
+    assert main(overrides + [f"output_model={model}", "valid_data="]) == 0
+    result = tmp_path / "cli_pred.tsv"
+    assert main([f"config={d}/predict.conf", "task=predict",
+                 f"data={d}/{test_file}", f"input_model={model}",
+                 f"output_result={result}", "verbosity=-1"]) == 0
+    cli_pred = np.loadtxt(result)
+
+    # ---- Python train on the same parsed data with the same params ----
+    params = dict(parse_args(overrides))
+    for k in ("task", "data", "valid_data", "output_model", "num_trees",
+              "config", "is_training_metric", "metric_freq"):
+        params.pop(k, None)
+    conf = Config(params)
+    pf_tr = load_file(f"{d}/{train_file}", header=conf.header)
+    ds = lgb.Dataset(pf_tr.X, label=pf_tr.label, weight=pf_tr.weight,
+                     group=pf_tr.group, init_score=pf_tr.init_score,
+                     params=params)
+    bst = lgb.train(params, ds, num_boost_round=rounds)
+    nf = pf_tr.X.shape[1]
+    pf_te = load_file(f"{d}/{test_file}", header=conf.header,
+                      num_features_hint=nf)
+    Xte = pf_te.X
+    if Xte.shape[1] < nf:
+        Xte = np.pad(Xte, ((0, 0), (0, nf - Xte.shape[1])))
+    py_pred = np.asarray(bst.predict(Xte))
+
+    assert cli_pred.shape == py_pred.shape
+    np.testing.assert_allclose(py_pred, cli_pred, rtol=1e-4, atol=1e-5)
+
+    # the model must not be degenerate (all-stump)
+    assert any(t.num_leaves > 1 for t in bst._ensure_host_trees())
