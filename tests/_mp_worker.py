@@ -103,7 +103,49 @@ def main():
     tds = np.asarray(multihost_utils.process_allgather(td))
     assert np.all(tds == tds[0]), f"trees diverge across ranks: {tds}"
 
-    print(f"MP_WORKER_OK rank={rank} num_leaves={nl}")
+    # ---- three FULL boosting iterations: grads -> dp tree -> score update,
+    # all on global cross-process arrays; every rank must hold the same
+    # replicated trees and the training loss must fall ----
+    from lightgbm_tpu.ops.gather import take_small
+    y_g = jax.make_array_from_process_local_data(
+        row, (y_l * mask_l).astype(np.float32))
+    m_g = c_g
+    shrink = 0.5
+
+    @jax.jit
+    def boost_iter(score, yv, mv, bg):
+        # global arrays must be ARGUMENTS (closing over non-addressable
+        # cross-process arrays is rejected by jax)
+        p = jax.nn.sigmoid(score)
+        g = (p - yv) * mv
+        h = jnp.maximum(p * (1 - p), 1e-6) * mv
+        tree, leaf_id = grow_tree_dp(bg, g, h, mv, num_bins, na_bin,
+                                     fmask, gp, mesh)
+        delta = take_small(tree.leaf_value * shrink, leaf_id)
+        ll = -jnp.sum(mv * (yv * jnp.log(p + 1e-9)
+                            + (1 - yv) * jnp.log(1 - p + 1e-9)))
+        return score + delta, tree, ll
+
+    score = jax.jit(
+        lambda m: m * 0.0,
+        out_shardings=row)(m_g)
+    lls = []
+    tree_digests = []
+    for _ in range(3):
+        score, tr, ll = boost_iter(score, y_g, m_g, bins_g)
+        lls.append(float(np.asarray(
+            multihost_utils.process_allgather(ll, tiled=True)).ravel()[0]))
+        tree_digests.append(_digest([
+            np.asarray(multihost_utils.process_allgather(
+                tr.split_feature, tiled=True))[: gp.num_leaves - 1],
+            np.asarray(multihost_utils.process_allgather(
+                tr.leaf_value, tiled=True))[: gp.num_leaves]]))
+    assert lls[-1] < lls[0], f"training loss did not fall: {lls}"
+    all_td = np.asarray(multihost_utils.process_allgather(
+        np.concatenate(tree_digests)))
+    assert np.all(all_td == all_td[0]), "iteration trees diverge across ranks"
+
+    print(f"MP_WORKER_OK rank={rank} num_leaves={nl} lls={lls}")
 
 
 if __name__ == "__main__":
