@@ -98,6 +98,7 @@ def main():
         "unit": "iters/sec",
         "vs_baseline": round(iters_per_sec / baseline_here, 4),
         "bin_s": round(t_bin, 2),
+        "bin_phases": ds.construct_phases,
         "compile_s": round(t_compile, 2),
         "train_auc": round(auc, 4),
     }

@@ -74,12 +74,15 @@ def _load_dataset(path: str, conf: Config, params: Dict, reference=None,
     X, label, weight, group, init = (pf.X, pf.label, pf.weight, pf.group,
                                      pf.init_score)
     if conf.num_machines > 1 and not conf.pre_partition and group is not None:
-        log.warning(
+        # fatal, not a warning: keeping the FULL file on every rank would make
+        # the data-parallel psum count each row num_machines times, silently
+        # rescaling min_data_in_leaf / min_sum_hessian / min_gain semantics
+        # (the reference partitions or rejects: metadata.cpp CheckOrPartition)
+        log.fatal(
             "num_machines > 1 with query/group data: automatic round-robin "
-            "row sharding cannot split whole queries — every machine keeps "
-            "the FULL file. Pre-partition the data by query and set "
-            "pre_partition=true (reference: dataset_loader.cpp:505 + "
-            "metadata.cpp CheckOrPartition)")
+            "row sharding cannot split whole queries. Pre-partition the data "
+            "by query and set pre_partition=true (reference: "
+            "dataset_loader.cpp:505 + metadata.cpp CheckOrPartition)")
     if conf.num_machines > 1 and not conf.pre_partition and group is None:
         # distributed load: every machine reads the file but keeps only its
         # round-robin row share (dataset_loader.cpp:505-541; pre_partition

@@ -10,6 +10,7 @@ dataset.cpp:742).
 from __future__ import annotations
 
 import json
+import time
 from typing import Any, Dict, List, Optional, Sequence, Union
 
 import numpy as np
@@ -115,6 +116,7 @@ class Dataset:
         self.categorical_feature = categorical_feature
         self.free_raw_data = free_raw_data
         self._constructed = False
+        self.construct_phases: Dict[str, Any] = {}
         self.bundle_meta = None   # set by construct() when EFB bundles
         self.pandas_categorical = None  # per-cat-column category lists
         # filled by construct():
@@ -188,6 +190,15 @@ class Dataset:
                                 ref._mtypes_np, ref.max_num_bins)
             return self
 
+        phases = self.construct_phases = {}
+        t_last = time.time()
+
+        def _mark(name):
+            nonlocal t_last
+            now = time.time()
+            phases[name] = round(now - t_last, 3)
+            t_last = now
+
         sparse_in = _is_scipy_sparse(self.raw_data)
         if sparse_in:
             raw = self.raw_data.tocsc()   # binned column-by-column, no dense
@@ -224,7 +235,9 @@ class Dataset:
                               "densify or use text-file loading")
             from .binning import bin_data_sparse, find_bin_mappers_sparse
             mappers = find_bin_mappers_sparse(raw, **bin_kw)
+            _mark("find_bins_s")
             binned = bin_data_sparse(raw, mappers)
+            _mark("encode_s")
         else:
             if conf.num_machines > 1:
                 from .parallel.mesh import init_distributed
@@ -238,7 +251,11 @@ class Dataset:
                 mappers = find_bin_mappers_distributed(raw, **bin_kw)
             else:
                 mappers = find_bin_mappers(raw, **bin_kw)
+            _mark("find_bins_s")
             binned = bin_data(raw, mappers)
+            _mark("encode_s")
+            from . import binning as _binning
+            phases["encoder"] = _binning.LAST_ENCODE_PATH
         self.mappers = binned.mappers
         self.feature_map = binned.feature_map
         self.bundle_meta = None
@@ -286,7 +303,10 @@ class Dataset:
             na_bin = np.array([m.na_bin for m in self.mappers], dtype=np.int32)
             mtypes = np.array([m.missing_type for m in self.mappers], dtype=np.int32)
         maxb = int(num_bins.max()) if len(num_bins) else 1
+        _mark("efb_s")
         self._finish_device(binned.bins, num_bins, na_bin, mtypes, maxb)
+        _mark("device_put_s")
+        log.info("Dataset.construct phases: %s", phases)
         return self
 
     def _finish_device(self, bins_np, num_bins_np, na_bin_np, mtypes_np, maxb):
@@ -419,7 +439,15 @@ class Dataset:
                         "group boundaries unless rows cover whole queries in "
                         "order; re-set group on the subset if needed")
         if self.init_score is not None:
-            ds.init_score = np.asarray(self.init_score)[idx]
+            isc = np.asarray(self.init_score)
+            n = self._num_data
+            if isc.ndim == 1 and isc.size != n and isc.size % n == 0:
+                # multiclass init_score is stored flat [n*k]; row-index the
+                # (n, k) view and re-flatten so subset rows keep all k scores
+                k = isc.size // n
+                ds.init_score = isc.reshape(n, k)[idx].reshape(-1)
+            else:
+                ds.init_score = isc[idx]
         ds._constructed = True
         return ds
 
@@ -594,9 +622,13 @@ class Booster:
 
     def predict(self, data, num_iteration: Optional[int] = None,
                 raw_score: bool = False, pred_leaf: bool = False,
-                pred_contrib: bool = False, **kwargs) -> np.ndarray:
+                pred_contrib: bool = False, **kwargs):
         """Batch prediction on raw features (reference: Booster.predict ->
-        Predictor, predictor.hpp:29)."""
+        Predictor, predictor.hpp:29).
+
+        Returns an ndarray, EXCEPT for scipy-sparse input with
+        ``pred_contrib=True`` which returns a scipy sparse matrix (reference
+        parity: sparse in -> sparse contribs out, c_api.h:747)."""
         if _is_scipy_sparse(data):
             # chunked densify: bounded [chunk, F] f64 intermediates instead of
             # the full dense matrix (reference predicts straight off CSR,
@@ -608,6 +640,13 @@ class Booster:
                                  raw_score=raw_score, pred_leaf=pred_leaf,
                                  pred_contrib=pred_contrib, **kwargs)
                     for i in range(0, csr.shape[0], chunk)]
+            if pred_contrib:
+                # sparse in -> sparse out (reference returns a sparse matrix
+                # for CSR pred_contrib, c_api.h:747): contribs of absent
+                # features are mostly zero, and a dense [n, F+1] for wide
+                # sparse data can exhaust host memory
+                from scipy import sparse as _sp
+                return _sp.vstack([_sp.csr_matrix(o) for o in outs])
             return np.concatenate(outs, axis=0)
         trees = self._ensure_host_trees()
         k = (self._gbdt.num_tree_per_iteration if self._gbdt

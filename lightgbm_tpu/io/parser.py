@@ -190,7 +190,10 @@ def _load_delimited_two_round(path: str, delim: str, header: bool
     n_rows = 0
     ncol = 0
     first = True
-    blank_re = re.compile(rb"(?:^|\n)[ \t\r]*(?:\n|$)")
+    # requires a REAL second newline so a chunk's terminating '\n' at
+    # end-of-chunk does not count as a blank line (chunks end at newline
+    # boundaries; the unterminated final carry is whitespace-checked below)
+    blank_re = re.compile(rb"(?:^|\n)[ \t\r]*\n")
     for chunk in _stream_line_chunks(path):
         if first:
             line = chunk.split(b"\n", 1)[0]
@@ -198,7 +201,7 @@ def _load_delimited_two_round(path: str, delim: str, header: bool
             first = False
         # fast path: newline count (+1 for a final unterminated line);
         # exact per-line scan only for chunks that contain blank lines
-        if blank_re.search(chunk):
+        if blank_re.search(chunk) or not chunk.strip():
             n_rows += sum(1 for ln in chunk.splitlines() if ln.strip())
         else:
             n_rows += chunk.count(b"\n") + (not chunk.endswith(b"\n"))

@@ -397,9 +397,17 @@ class LambdaRank(ObjectiveFunction):
     TPU reformulation: queries are padded into a dense [Q, M] doc grid; the
     per-query pairwise lambda computation (reference's nested loops,
     rank_objective.hpp:83-130) becomes batched masked [Q, T, M] tensor ops
-    with T = truncation_level over the score-sorted docs (the reference's
-    exact pair set), executed in bounded-memory query chunks via lax.map —
-    see _lambdarank_grid.
+    with T = truncation_level over the score-sorted docs, executed in
+    bounded-memory query chunks via lax.map — see _lambdarank_grid.
+
+    NOTE on truncation semantics: v2.3.2's pair loop is untruncated
+    (``lambdarank_truncation_level`` only caps MaxDCG via CalMaxDCGAtK,
+    rank_objective.hpp:63,117); truncating the high-position axis of the pair
+    set follows NEWER-upstream (>=3.0) semantics, adopted here because it
+    bounds the pair tensor to [Q, T, M]. Set
+    ``lambdarank_truncation_level >= max docs per query`` for the exact
+    v2.3.2 pair set. The norm path matches v2.3.2 exactly (score-distance
+    regularization + 2*sum|lambda| denominator).
     """
     name = "lambdarank"
     need_group = True
@@ -467,12 +475,11 @@ def _lambdarank_grid(sc, lab, msk, label_gain, inv_max_dcg, sigmoid, trunc,
     Two structural bounds keep memory finite (round-2 VERDICT weak #4 — the
     old [Q, M, M] grid OOMed on MS-LTR-class queries):
 
-    1. **Truncation axis**: the reference's pair loop
-       (rank_objective.hpp:83-130) only iterates ``i < truncation_level`` over
-       the score-SORTED docs, so the pair tensor is [Q, T, M] with
-       T = min(truncation_level, M) — at MS-LTR scale (M~1250, T=30) that is
-       40x smaller than M x M, and it is exactly the reference's pair set,
-       not an approximation.
+    1. **Truncation axis**: the earlier sorted position of each pair is capped
+       at ``i < truncation_level`` (newer-upstream semantics; v2.3.2 itself
+       iterates ALL positions — see the LambdaRank class docstring), so the
+       pair tensor is [Q, T, M] with T = min(truncation_level, M) — at MS-LTR
+       scale (M~1250, T=30) that is 40x smaller than M x M.
     2. **Query chunking**: a ``lax.map`` over query chunks bounds the live
        pair tensor to ~16M elements regardless of Q.
     """
@@ -505,6 +512,15 @@ def _lambdarank_grid(sc, lab, msk, label_gain, inv_max_dcg, sigmoid, trunc,
         # high/low by label, rank_objective.hpp:95-103)
         i_is_high = g_i > g_j
         ds = jnp.where(i_is_high, s_i - s_j, s_j - s_i)
+        if norm:
+            # score-distance regularization (rank_objective.hpp:146-149):
+            # delta_pair_NDCG /= (0.01 + |delta_score|), only when the query
+            # has score spread (best_score != worst_score over valid docs)
+            best = jnp.max(jnp.where(msk_c, sc_c, -jnp.inf), axis=1)
+            worst = jnp.min(jnp.where(msk_c, sc_c, jnp.inf), axis=1)
+            spread = (best != worst)[:, None, None]
+            delta_pair = jnp.where(
+                spread, delta_pair / (0.01 + jnp.abs(ds)), delta_pair)
         p = 1.0 / (1.0 + jnp.exp(sigmoid * ds))    # P(low beats high)
         lam = -sigmoid * p * delta_pair            # dL/ds_high (negative)
         hes = sigmoid * sigmoid * p * (1.0 - p) * delta_pair
@@ -518,9 +534,13 @@ def _lambdarank_grid(sc, lab, msk, label_gain, inv_max_dcg, sigmoid, trunc,
         hess_s = hes.sum(axis=1)
         hess_s = hess_s.at[:, :t].add(hes.sum(axis=2))
         if norm:
-            # normalize by total |lambda| per query (lambdarank_norm)
-            denom = jnp.abs(lam).sum(axis=(1, 2))[:, None] + 1e-9
-            scale = jnp.log2(1.0 + denom) / denom
+            # normalize by sum_lambdas accumulated as 2*sum|lambda| per query
+            # (rank_objective.hpp:161 sum_lambdas -= 2*p_lambda), applied only
+            # when sum_lambdas > 0 (rank_objective.hpp:167-173)
+            denom = 2.0 * jnp.abs(lam).sum(axis=(1, 2))[:, None]
+            scale = jnp.where(
+                denom > 0.0, jnp.log2(1.0 + denom) / jnp.maximum(denom, 1e-30),
+                1.0)
             grad_s = grad_s * scale
             hess_s = hess_s * scale
         # unsort back to doc-grid order
