@@ -50,8 +50,22 @@ def parse_args(argv: List[str]) -> Dict[str, str]:
     return merged
 
 
+def _load_initscore(path: str) -> np.ndarray:
+    """Explicit init-score file (reference: initscore_filename /
+    valid_data_initscores, metadata.cpp:521 LoadInitialScore). Goes through
+    the vfs layer like the <data>.init sidecar loader (io/parser.py)."""
+    from .io.vfs import exists, open_file
+    if not exists(path):
+        log.fatal(f"Initial score file {path} does not exist")
+    with open_file(path, "rb") as fh:
+        init = np.loadtxt(fh, dtype=np.float64)
+    log.info(f"Loading initial scores from {path}")
+    return init
+
+
 def _load_dataset(path: str, conf: Config, params: Dict, reference=None,
-                  num_features_hint: int = 0) -> Dataset:
+                  num_features_hint: int = 0,
+                  initscore_path: str = "") -> Dataset:
     # binary dataset cache (reference: auto-load of <data>.bin,
     # application.cpp LoadData + save_binary). Disabled for auto-partitioned
     # distributed runs: every rank would race-write its ROW SHARD to the same
@@ -62,6 +76,10 @@ def _load_dataset(path: str, conf: Config, params: Dict, reference=None,
         try:
             ds = Dataset.load_binary(bin_path, params=params)
             log.info(f"Loaded binned dataset from {bin_path}")
+            if initscore_path:
+                # an explicit init-score file overrides whatever the cache
+                # captured (it must not be silently skipped on a cache hit)
+                ds.init_score = _load_initscore(initscore_path)
             return ds
         except Exception:
             pass
@@ -73,6 +91,8 @@ def _load_dataset(path: str, conf: Config, params: Dict, reference=None,
                    two_round=conf.two_round)
     X, label, weight, group, init = (pf.X, pf.label, pf.weight, pf.group,
                                      pf.init_score)
+    if initscore_path:
+        init = _load_initscore(initscore_path)
     if conf.num_machines > 1 and not conf.pre_partition and group is not None:
         # fatal, not a warning: keeping the FULL file on every rank would make
         # the data-parallel psum count each row num_machines times, silently
@@ -118,10 +138,14 @@ def run_train(conf: Config, params: Dict) -> None:
     if not conf.data:
         log.fatal("No training data: set data=<file>")
     t0 = time.time()
-    train_set = _load_dataset(conf.data, conf, params)
+    train_set = _load_dataset(conf.data, conf, params,
+                              initscore_path=conf.initscore_filename)
     valid_sets, valid_names = [], []
-    for vpath in conf.valid:
-        vs = _load_dataset(vpath, conf, params, reference=train_set)
+    vinits = list(conf.valid_data_initscores or [])
+    for vi, vpath in enumerate(conf.valid):
+        vs = _load_dataset(vpath, conf, params, reference=train_set,
+                           initscore_path=(vinits[vi]
+                                           if vi < len(vinits) else ""))
         valid_sets.append(vs)
         valid_names.append(os.path.basename(vpath))
     log.info(f"Finished loading data in {time.time() - t0:.6f} seconds")
@@ -154,7 +178,9 @@ def run_predict(conf: Config, params: Dict) -> None:
         X = np.pad(X, ((0, 0), (0, nf - X.shape[1])))
     pred = booster.predict(
         X, raw_score=conf.predict_raw_score,
-        pred_leaf=conf.predict_leaf_index, pred_contrib=conf.predict_contrib)
+        pred_leaf=conf.predict_leaf_index, pred_contrib=conf.predict_contrib,
+        num_iteration=(conf.num_iteration_predict
+                       if conf.num_iteration_predict > 0 else None))
     out = np.asarray(pred)
     if out.ndim == 1:
         out = out[:, None]
@@ -193,6 +219,10 @@ def run_refit(conf: Config, params: Dict) -> None:
 def run_convert_model(conf: Config, params: Dict) -> None:
     if not conf.input_model:
         log.fatal("No model file: set input_model=<file>")
+    if conf.convert_model_language not in ("", "cpp"):
+        log.fatal(f"convert_model_language={conf.convert_model_language} is "
+                  "not supported; only cpp is (matching the reference, "
+                  "config.h:660)")
     from .io.model_text import model_to_cpp
     booster = Booster(model_file=conf.input_model)
     out = conf.convert_model if conf.convert_model else "gbdt_prediction.cpp"

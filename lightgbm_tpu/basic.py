@@ -160,6 +160,9 @@ class Dataset:
 
     def _construct_inner(self) -> "Dataset":
         conf = params_to_config(self.params)
+        if conf.num_threads and conf.num_threads > 0:
+            from .native import set_num_threads
+            set_num_threads(conf.num_threads)
         if self.reference is not None:
             ref = self.reference.construct()
             self.mappers = ref.mappers
@@ -221,7 +224,8 @@ class Dataset:
             max_bin=conf.max_bin, min_data_in_bin=conf.min_data_in_bin,
             sample_cnt=conf.bin_construct_sample_cnt, categorical=cats,
             use_missing=conf.use_missing, zero_as_missing=conf.zero_as_missing,
-            seed=conf.data_random_seed, forced_bins=forced_bins)
+            seed=conf.data_random_seed, forced_bins=forced_bins,
+            max_bin_by_feature=conf.max_bin_by_feature)
         distributed = False
         if sparse_in:
             if conf.num_machines > 1:
@@ -266,6 +270,14 @@ class Dataset:
             log.warning("EFB bundling is disabled under distributed bin "
                         "finding (rank-local conflict counts would produce "
                         "divergent bundle plans)")
+        elif (conf.enable_bundle and binned.bins.shape[1] >= 3
+                and any(float(v) != 1.0 for v in (conf.feature_contri or []))):
+            # a bundle column's split candidates span several member features;
+            # one gain multiplier per column cannot represent per-member
+            # contris, so bundling is turned off rather than mis-penalizing
+            log.warning("EFB bundling is disabled because feature_contri is "
+                        "set (per-feature gain multipliers cannot apply to "
+                        "merged bundle columns)")
         elif conf.enable_bundle and binned.bins.shape[1] >= 3:
             from .efb import apply_bundles, plan_bundles
             # monotone-constrained features must keep their own columns: the
@@ -431,13 +443,23 @@ class Dataset:
         if self.weight is not None:
             ds.weight = jnp.take(jnp.asarray(self.weight), idx_dev)
         if self.group is not None:
-            # row subsetting cannot preserve arbitrary query boundaries
-            # (reference subset requires sorted whole groups); callers doing
-            # ranking must re-set group sizes on the subset themselves —
-            # cv() refuses ranking objectives outright (engine.cv)
-            log.warning("Dataset.subset on grouped (ranking) data drops the "
-                        "group boundaries unless rows cover whole queries in "
-                        "order; re-set group on the subset if needed")
+            # preserve query boundaries when idx selects WHOLE queries in
+            # order (the reference's subset contract: sorted indices covering
+            # complete groups, Metadata handling in Dataset::CopySubrow) —
+            # this is what cv()'s group-aware ranking folds produce
+            idx_np = np.asarray(idx)
+            bounds = np.cumsum(self.group)
+            qid = np.searchsorted(bounds, idx_np, side="right")
+            counts = np.bincount(qid, minlength=len(self.group))
+            whole = np.all((counts == 0) | (counts == self.group))
+            ordered = np.all(np.diff(idx_np) > 0) if len(idx_np) > 1 else True
+            if whole and ordered:
+                ds.group = self.group[counts > 0].copy()
+            else:
+                log.warning("Dataset.subset on grouped (ranking) data drops "
+                            "the group boundaries unless rows cover whole "
+                            "queries in order; re-set group on the subset if "
+                            "needed")
         if self.init_score is not None:
             isc = np.asarray(self.init_score)
             n = self._num_data

@@ -348,6 +348,7 @@ def find_bin_mappers(
     zero_as_missing: bool = False,
     seed: int = 1,
     forced_bins: Optional[Dict[int, Sequence[float]]] = None,
+    max_bin_by_feature: Optional[Sequence[int]] = None,
 ) -> List[BinMapper]:
     """Find per-feature bin mappers from a row sample of ``data`` [N, F]."""
     n, f = data.shape
@@ -358,10 +359,11 @@ def find_bin_mappers(
     else:
         sample = data
     cats = set(categorical or ())
+    per_feat_bin = _check_max_bin_by_feature(max_bin_by_feature, f, max_bin)
     mappers = []
     for j in range(f):
         mappers.append(BinMapper.from_sample(
-            sample[:, j], len(sample), max_bin,
+            sample[:, j], len(sample), per_feat_bin[j],
             min_data_in_bin=min_data_in_bin,
             bin_type=BIN_CATEGORICAL if j in cats else BIN_NUMERICAL,
             use_missing=use_missing,
@@ -369,6 +371,26 @@ def find_bin_mappers(
             forced_bounds=(forced_bins or {}).get(j),
         ))
     return mappers
+
+
+def _check_max_bin_by_feature(max_bin_by_feature, num_features: int,
+                              max_bin: int) -> List[int]:
+    """Per-feature bin budgets (reference: config.h:502 max_bin_by_feature,
+    validated in Dataset::Construct, dataset.cpp:407-411: length must equal
+    the feature count and every entry must exceed 1)."""
+    if not max_bin_by_feature:
+        return [max_bin] * num_features
+    vals = [int(v) for v in max_bin_by_feature]
+    if len(vals) != num_features:
+        log.fatal(f"max_bin_by_feature has {len(vals)} entries but the data "
+                  f"has {num_features} features")
+    if min(vals) <= 1:
+        log.fatal("every entry of max_bin_by_feature must be > 1")
+    if max(vals) > 256:
+        log.warning("max_bin_by_feature entries > 256 not supported on TPU "
+                    "(uint8 bins); clamping to 256")
+        vals = [min(v, 256) for v in vals]
+    return vals
 
 
 def find_bin_mappers_sparse(
@@ -381,6 +403,7 @@ def find_bin_mappers_sparse(
     zero_as_missing: bool = False,
     seed: int = 1,
     forced_bins: Optional[Dict[int, Sequence[float]]] = None,
+    max_bin_by_feature: Optional[Sequence[int]] = None,
 ) -> List[BinMapper]:
     """Per-feature mappers from a scipy CSC matrix WITHOUT densifying.
 
@@ -400,12 +423,13 @@ def find_bin_mappers_sparse(
         total = n
     sub = sub.tocsc()
     cats = set(categorical or ())
+    per_feat_bin = _check_max_bin_by_feature(max_bin_by_feature, f, max_bin)
     mappers = []
     for j in range(f):
         vals = np.asarray(sub.data[sub.indptr[j]: sub.indptr[j + 1]],
                           dtype=np.float64)
         mappers.append(BinMapper.from_sample(
-            vals, total, max_bin,
+            vals, total, per_feat_bin[j],
             min_data_in_bin=min_data_in_bin,
             bin_type=BIN_CATEGORICAL if j in cats else BIN_NUMERICAL,
             use_missing=use_missing,

@@ -78,6 +78,9 @@ _PARAMS: Dict[str, Tuple[Any, Tuple[str, ...]]] = {
     "verbosity": (1, ("verbose",)),
     # ---- dataset ----
     "max_bin": (255, ("max_bins",)),
+    # per-feature bin budget (reference: config.h:502, consumed in
+    # Dataset::Construct via DatasetLoader — here in find_bin_mappers)
+    "max_bin_by_feature": ([], ()),
     "min_data_in_bin": (3, ()),
     "bin_construct_sample_cnt": (200000, ("subsample_for_bin",)),
     "histogram_pool_size": (-1.0, ("hist_pool_size",)),
@@ -167,7 +170,7 @@ _PARAMS: Dict[str, Tuple[Any, Tuple[str, ...]]] = {
 }
 
 _LIST_FLOAT = {"feature_contri", "cegb_penalty_feature_lazy", "cegb_penalty_feature_coupled", "label_gain"}
-_LIST_INT = {"monotone_constraints", "eval_at"}
+_LIST_INT = {"monotone_constraints", "eval_at", "max_bin_by_feature"}
 _LIST_STR = {"valid", "metric", "valid_data_initscores"}
 _MAYBE_INT = {"seed"}
 

@@ -227,22 +227,38 @@ def cv(params: Dict[str, Any], train_set: Dataset, num_boost_round: int = 100,
     conf = params_to_config(params)
     if conf.num_iterations != 100 and num_boost_round == 100:
         num_boost_round = conf.num_iterations
-    if conf.objective in ("lambdarank", "rank_xendcg", "xendcg", "xe_ndcg",
-                          "xe_ndcg_mart", "rank_xendcg_mart"):
-        # row-based folds cannot split whole queries and subset() drops group
-        # boundaries (reference cv handles groups in _make_n_folds; not
-        # implemented here — refuse loudly rather than fatal deep inside
-        # LambdaRank.init)
-        log.fatal("cv() does not support ranking objectives: fold rows "
-                  "cannot preserve query boundaries; split queries manually "
-                  "and call train() per fold")
+    ranking = conf.objective in ("lambdarank", "rank_xendcg", "xendcg",
+                                 "xe_ndcg", "xe_ndcg_mart", "rank_xendcg_mart")
+    if ranking and train_set.group is None:
+        log.fatal("cv() with a ranking objective needs query/group "
+                  "information on the Dataset")
     train_set.construct()
     label = np.asarray(train_set.label)
     n = train_set.num_data
 
     if folds is None:
         rng = np.random.RandomState(seed)
-        if stratified and conf.objective in ("binary", "multiclass", "multiclassova"):
+        if ranking:
+            # group-aware folds (reference: _make_n_folds engine.py:299 uses
+            # GroupKFold over the flattened query ids): folds are WHOLE
+            # queries, indices sorted, so Dataset.subset keeps boundaries
+            group = np.asarray(train_set.group)
+            nq = len(group)
+            q_order = rng.permutation(nq) if shuffle else np.arange(nq)
+            bounds = np.concatenate([[0], np.cumsum(group)])
+            folds = []
+            for part in np.array_split(q_order, nfold):
+                va_q = np.zeros(nq, bool)
+                va_q[part] = True
+                va_idx = np.concatenate(
+                    [np.arange(bounds[q], bounds[q + 1])
+                     for q in np.flatnonzero(va_q)]) if part.size else \
+                    np.empty(0, np.int64)
+                tr_idx = np.concatenate(
+                    [np.arange(bounds[q], bounds[q + 1])
+                     for q in np.flatnonzero(~va_q)])
+                folds.append((tr_idx, va_idx))
+        elif stratified and conf.objective in ("binary", "multiclass", "multiclassova"):
             from sklearn.model_selection import StratifiedKFold
             skf = StratifiedKFold(n_splits=nfold, shuffle=shuffle,
                                   random_state=seed if shuffle else None)

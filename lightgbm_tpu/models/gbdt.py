@@ -135,6 +135,7 @@ class GBDT:
                 max_cat_to_onehot=config.max_cat_to_onehot,
                 min_data_per_group=config.min_data_per_group,
                 monotone_constraints=self._monotone_tuple(config, train_set),
+                feature_contri=self._contri_tuple(config, train_set),
                 has_bundles=getattr(train_set, "bundle_meta", None) is not None,
                 cegb_tradeoff=config.cegb_tradeoff,
                 cegb_penalty_split=(config.cegb_penalty_split
@@ -290,6 +291,29 @@ class GBDT:
             ("pred_early_stop", False,
              "prediction early-stopping has no latency benefit here: the TPU "
              "batch predictor evaluates all trees in parallel"),
+            ("pred_early_stop_freq", 10, "see pred_early_stop"),
+            ("pred_early_stop_margin", 10.0, "see pred_early_stop"),
+            ("device_type", "tpu",
+             "the compute device is whatever backend JAX initialized "
+             "(TPU here); there is no OpenCL path to select"),
+            ("force_col_wise", False,
+             "histogram construction layout is chosen by histogram_impl "
+             "(auto-tuned Pallas/onehot kernels), not col/row-wise forcing"),
+            ("force_row_wise", False, "see force_col_wise"),
+            ("is_enable_sparse", True,
+             "bins are always a dense device matrix by design (EFB provides "
+             "the sparse-data compression; SURVEY.md §7 design stance)"),
+            ("gpu_platform_id", -1, "no OpenCL on TPU"),
+            ("gpu_device_id", -1, "no OpenCL on TPU"),
+            ("gpu_use_dp", False,
+             "histograms accumulate in f32 (+int8 quantized path); f64 "
+             "accumulation is not available on the MXU"),
+            ("hist_dtype", "float32",
+             "histograms accumulate in f32 on TPU; other dtypes are not "
+             "implemented"),
+            ("mesh_axis", "data",
+             "custom mesh axis names are not plumbed through shard_map "
+             "specs yet; the data axis is named 'data'"),
         ]
         for name, default, why in checks:
             if getattr(config, name, default) != default:
@@ -377,6 +401,41 @@ class GBDT:
         else:
             out = used
         return tuple(int(v) for v in out)
+
+    @staticmethod
+    def _contri_tuple(config, train_set) -> tuple:
+        """Map raw-column feature_contri (split-gain multipliers, reference
+        dataset.cpp:394-400) to GROWER column order, clamped at 0 like
+        feature_penalty_. Dataset disables EFB when it sees feature_contri at
+        construct time; for a dataset constructed BEFORE the param arrived,
+        bundle columns exist — single-member columns keep their feature's
+        contri, merged columns fall back to 1.0 with a warning (one gain
+        multiplier per column cannot represent per-member contris)."""
+        fc = list(config.feature_contri or [])
+        if not fc or all(float(v) == 1.0 for v in fc):
+            return ()
+        nraw = train_set._num_features_raw or len(fc)
+        if len(fc) != nraw:
+            log.fatal(f"feature_contri has {len(fc)} entries but the data has "
+                      f"{nraw} features (reference: dataset.cpp:395 CHECK)")
+        fm = train_set.feature_map
+        if fm is None:
+            used = fc
+        else:
+            used = [fc[int(orig)] if int(orig) < len(fc) else 1.0
+                    for orig in fm]
+        meta = getattr(train_set, "bundle_meta", None)
+        if meta is not None:
+            merged = [i for i, mem in enumerate(meta.members) if len(mem) > 1]
+            if merged and any(
+                    float(used[m[0]]) != 1.0
+                    for i in merged for m in meta.members[i]):
+                log.warning("feature_contri on EFB-merged bundle columns is "
+                            "approximated as 1.0 (construct the Dataset with "
+                            "feature_contri in params to disable bundling)")
+            used = [used[mem[0][0]] if len(mem) == 1 else 1.0
+                    for mem in meta.members]
+        return tuple(max(0.0, float(v)) for v in used)
 
     # ---- valid sets (reference: GBDT::AddValidDataset, gbdt.cpp) ----
     def add_valid(self, valid_set, name: str) -> None:
@@ -827,6 +886,14 @@ class GBDT:
     def rollback_one_iter(self) -> None:
         if self.iter_ <= 0:
             return
+        # the lagged finished-check queue (_grow_and_update) holds leaf counts
+        # of SPECIFIC iterations; after popping an iteration those entries are
+        # misaligned, and an aged-out all-stump entry could pop trees whose
+        # score deltas stay baked into train/valid scores (VERDICT r3 weak
+        # #7). Clearing only delays stop detection by <= 8 iterations.
+        q = getattr(self, "_pending_leafcounts_q", None)
+        if q:
+            q.clear()
         self.models_host = []  # invalidate host cache; rebuilt on demand
         k = self.num_tree_per_iteration
         for cls in reversed(range(k)):

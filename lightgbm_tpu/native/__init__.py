@@ -95,6 +95,8 @@ def get_lib():
                                     ctypes.POINTER(ctypes.c_int64),
                                     ctypes.POINTER(ctypes.c_int32),
                                     ctypes.POINTER(ctypes.c_uint8)]
+        lib.set_num_threads.restype = None
+        lib.set_num_threads.argtypes = [ctypes.c_int]
         lib.bin_columns_f32.restype = None
         lib.bin_columns_f32.argtypes = [ctypes.POINTER(ctypes.c_float),
                                         ctypes.c_int64, ctypes.c_int64,
@@ -109,6 +111,14 @@ def get_lib():
                     f"construction)")
         _lib = None
     return _lib
+
+
+def set_num_threads(n: int) -> None:
+    """Cap native worker threads (reference: num_threads, config.h:122; the
+    OpenMP thread-count analog for the std::thread parse/bin pools)."""
+    lib = get_lib()
+    if lib is not None:
+        lib.set_num_threads(int(n))
 
 
 def _dptr(a):

@@ -25,6 +25,10 @@
 
 namespace {
 
+// 0 = auto (hardware concurrency); set via set_num_threads (the reference's
+// num_threads / OMP_NUM_THREADS analog, config.h:122)
+std::atomic<int> g_num_threads{0};
+
 inline bool is_na_token(const char* s, size_t len) {
   if (len == 0) return true;
   // na / nan / null / none / n/a / unknown / ? (parser.h NA conventions)
@@ -81,6 +85,8 @@ LineIndex index_lines(const char* buf, int64_t n_bytes) {
 }
 
 int hardware_threads() {
+  int forced = g_num_threads.load(std::memory_order_relaxed);
+  if (forced > 0) return forced;
   unsigned n = std::thread::hardware_concurrency();
   return n ? static_cast<int>(n) : 4;
 }
@@ -106,6 +112,11 @@ void parallel_for(int64_t n, Fn fn) {
 }  // namespace
 
 extern "C" {
+
+// Cap worker threads (num_threads param; 0 restores auto-detection).
+void set_num_threads(int n) {
+  g_num_threads.store(n, std::memory_order_relaxed);
+}
 
 // Count rows & delimited columns of the first data line. Returns rows.
 int64_t csv_dims(const char* buf, int64_t n_bytes, char delim, int64_t* n_cols) {

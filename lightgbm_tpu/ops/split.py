@@ -52,6 +52,12 @@ class SplitParams:
     # (reference: monotone_constraints.hpp ConstraintEntry + the direction
     # filter in FindBestThresholdSequence)
     monotone_constraints: tuple = ()
+    # per-USED-COLUMN split-gain multipliers (reference: feature_contri,
+    # dataset.cpp:394-400 feature_penalty_, applied to each feature's best
+    # gain in FindBestThreshold, feature_histogram.hpp:89). STATIC tuple in
+    # GROWER-column space (GBDT._contri_tuple maps original->used->bundle
+    # columns and clamps at 0); empty = off.
+    feature_contri: tuple = ()
     # EFB: bundled columns present (static flag; the BundleArrays data rides
     # along as a traced argument)
     has_bundles: bool = False
@@ -68,6 +74,19 @@ class SplitParams:
     @property
     def has_monotone(self) -> bool:
         return any(m != 0 for m in self.monotone_constraints)
+
+    @property
+    def has_contri(self) -> bool:
+        return any(c != 1.0 for c in self.feature_contri)
+
+    def contri_array(self, f: int) -> np.ndarray:
+        """[F] f32 gain multipliers in grower-column space: the registered
+        tuple clamped at 0 (feature_penalty_, dataset.cpp:400) and padded
+        with 1.0 to width f."""
+        out = np.ones(f, dtype=np.float32)
+        cvals = np.maximum(np.asarray(self.feature_contri, np.float32), 0.0)
+        out[: len(cvals)] = cvals[:f]
+        return out
 
     @property
     def has_cegb(self) -> bool:
@@ -167,7 +186,13 @@ def per_feature_gains(hist: jnp.ndarray, num_bins: jnp.ndarray,
           & (iota < num_bins[None, :, None] - 1) & (~na_sel))
     gain = leaf_split_gain(lg, lh, p) + leaf_split_gain(rg, rh, p)
     gain = jnp.where(ok, gain, NEG_INF)
-    return gain.max(axis=-1).reshape(batch_shape + (f,))
+    best = gain.max(axis=-1)                                     # [L, F]
+    if p.has_contri:
+        # keep the vote ranking consistent with the penalized final search
+        parent = leaf_split_gain(pg, ph, p)                      # [L]
+        best = p.contri_array(f)[None, :] * (best - parent[:, None]
+                                             - p.min_gain_to_split)
+    return best.reshape(batch_shape + (f,))
 
 
 def best_split(hist: jnp.ndarray, num_bins: jnp.ndarray, na_bin: jnp.ndarray,
@@ -265,6 +290,21 @@ def best_split(hist: jnp.ndarray, num_bins: jnp.ndarray, na_bin: jnp.ndarray,
     gain_r = jnp.where(valid_t, gain_r, NEG_INF)
     gain_l = jnp.where(valid_t & has_na, gain_l, NEG_INF)
 
+    # feature_contri: the reference multiplies each feature's best gain —
+    # which is stored as (improvement - min_gain_shift) — by the per-feature
+    # penalty BEFORE the cross-feature comparison (feature_histogram.hpp:89
+    # output->gain *= meta_->penalty, with gain = best - min_gain_shift from
+    # FindBestThresholdSequence). So in contri mode every candidate plane is
+    # rewritten to penalized improvement: contri_f * (gain - parent - min_gain)
+    # and the final argmax/threshold operate on that directly.
+    parent_gain = leaf_split_gain(pg, ph, p)                      # [L]
+    contri_dev = None
+    if p.has_contri:
+        contri_dev = jnp.asarray(p.contri_array(f))
+        shift = (parent_gain + p.min_gain_to_split)[:, None, None]  # [L,1,1]
+        gain_r = contri_dev[None, :, None] * (gain_r - shift)
+        gain_l = contri_dev[None, :, None] * (gain_l - shift)
+
     pen_lf = None
     if gain_penalty is not None:
         pen_lf = (jnp.broadcast_to(gain_penalty, batch_shape + (f,))
@@ -357,6 +397,11 @@ def best_split(hist: jnp.ndarray, num_bins: jnp.ndarray, na_bin: jnp.ndarray,
         gain_asc = subset_gains(*asc)
         gain_desc = subset_gains(*desc)
         left_asc, left_desc = asc, desc
+        if contri_dev is not None:
+            cc = contri_dev[jnp.asarray(cat_idx)][None, :, None]
+            gain_oh = cc * (gain_oh - shift)
+            gain_asc = cc * (gain_asc - shift)
+            gain_desc = cc * (gain_desc - shift)
         if pen_lf is not None:
             pen_c = pen_lf[:, cat_idx][:, :, None]
             gain_oh = gain_oh - pen_c
@@ -404,6 +449,10 @@ def best_split(hist: jnp.ndarray, num_bins: jnp.ndarray, na_bin: jnp.ndarray,
         else:
             gainB = leaf_split_gain(lgB, lhB, p) + leaf_split_gain(rgB, rhB, p)
         gainB = jnp.where(okB, gainB, NEG_INF)
+        if contri_dev is not None:
+            # bundle columns carry their mapped contri (single-member columns:
+            # the member's value; merged: 1.0 — see GBDT._contri_tuple)
+            gainB = contri_dev[None, :, None] * (gainB - shift)
         if pen_lf is not None:
             gainB = gainB - pen_lf[:, :, None]
         sections.append(gainB.reshape(L, f * b))
@@ -488,10 +537,17 @@ def best_split(hist: jnp.ndarray, num_bins: jnp.ndarray, na_bin: jnp.ndarray,
         left_h_ = jnp.where(is_bun, lhB[lidx, bf, bp], left_h_)
         left_c_ = jnp.where(is_bun, lcB[lidx, bf, bp], left_c_)
 
-    parent_gain = leaf_split_gain(pg, ph, p)
-    improvement = best_gain - parent_gain
-    found = allow & (best_gain > NEG_INF / 2) \
-        & (improvement > p.min_gain_to_split) & (improvement > 0.0)
+    if p.has_contri:
+        # planes already hold contri * (improvement - min_gain); a masked
+        # candidate can never win (it is <= 0 after the transform) so the
+        # positivity check alone gates splitting (serial_tree_learner.cpp:184
+        # best_split_info.gain <= 0 stop, on penalized gains)
+        improvement = best_gain
+        found = allow & (improvement > 0.0)
+    else:
+        improvement = best_gain - parent_gain
+        found = allow & (best_gain > NEG_INF / 2) \
+            & (improvement > p.min_gain_to_split) & (improvement > 0.0)
 
     res = SplitResult(
         gain=jnp.where(found, improvement, NEG_INF),

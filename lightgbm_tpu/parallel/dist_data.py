@@ -101,6 +101,7 @@ def find_bin_mappers_distributed(
     zero_as_missing: bool = False,
     seed: int = 1,
     forced_bins=None,
+    max_bin_by_feature=None,
 ) -> List[BinMapper]:
     """Identical-by-construction mappers across jax.distributed processes.
 
@@ -124,9 +125,11 @@ def find_bin_mappers_distributed(
         use_missing=use_missing, zero_as_missing=zero_as_missing,
         seed=seed + rank,
         forced_bins={k - lo: v for k, v in (forced_bins or {}).items()
-                     if lo <= k < hi})
+                     if lo <= k < hi},
+        max_bin_by_feature=(list(max_bin_by_feature)[lo:hi]
+                            if max_bin_by_feature else None))
 
-    width = _HDR + max_bin + 2
+    width = _HDR + max(max_bin, *(max_bin_by_feature or [0])) + 2
     enc = np.zeros((f, width), dtype=np.float64)
     for j, m in enumerate(local):
         enc[lo + j] = _encode_mapper(m, width)

@@ -72,9 +72,21 @@ def init_distributed(config) -> bool:
         return False
     if _DISTRIBUTED_INITIALIZED:
         return True
+    machines = config.machines
+    if not machines and config.machine_list_filename:
+        # reference: machine_list_filename — one host[:port] per line
+        # (linkers_socket.cpp:80 ParseMachineList)
+        with open(config.machine_list_filename) as fh:
+            entries = [ln.split("#", 1)[0].strip() for ln in fh]
+            # 'host port' lines (any whitespace) -> 'host:port'
+            machines = ",".join(":".join(e.split()) for e in entries if e)
     coords = None
-    if config.machines:
-        coords = config.machines.split(",")[0].strip()
+    if machines:
+        coords = machines.split(",")[0].strip()
+        if ":" not in coords:
+            # entries without a port listen on local_listen_port (reference:
+            # config.h local_listen_port default 12400)
+            coords = f"{coords}:{config.local_listen_port}"
     import os
     pid = os.environ.get("JAX_PROCESS_ID")
     kwargs = {"num_processes": config.num_machines}
@@ -82,6 +94,11 @@ def init_distributed(config) -> bool:
         kwargs["coordinator_address"] = coords
     if pid is not None:
         kwargs["process_id"] = int(pid)
+    if config.time_out and config.time_out > 0:
+        # reference time_out is in minutes (config.h:306); jax takes seconds.
+        # Applied unconditionally so the 120-minute default is honored too
+        # (jax's own default is only ~5 minutes)
+        kwargs["initialization_timeout"] = int(config.time_out) * 60
     jax.distributed.initialize(**kwargs)
     _DISTRIBUTED_INITIALIZED = True
     log.info(f"jax.distributed initialized: process {jax.process_index()} "

@@ -1,0 +1,216 @@
+"""AUC-parity benchmark against a locally built reference LightGBM CLI.
+
+VERDICT r3 missing #1 / next #2: prove the end-to-end trainer matches
+reference accuracy at the reference's own operating point (500 iterations,
+255 leaves, 63 bins, lr 0.1 — docs/Experiments.rst:103-128) instead of the
+old `auc > 0.75` sanity floor.
+
+Usage:
+    python scripts/parity_bench.py [--rows 1000000] [--iters 500]
+        [--ref-cli .refbuild/lightgbm] [--out PARITY_BENCH.json]
+        [--bench-floor-entry]   # also record a {rows,iters} train-AUC entry
+                                # for bench.py's quality assert
+
+Writes/updates a JSON file with entries keyed by the run configuration:
+    {"entries": [{"rows": N, "iters": I, "leaves": L, "bins": B,
+                  "ref_train_auc": ..., "ref_valid_auc": ...,
+                  "ref_train_time_s": ...}, ...],
+     "parity": {"tpu_valid_auc": ..., "ref_valid_auc": ..., "delta": ...}}
+
+The reference CLI binary is NOT committed (build it with cmake from
+/root/reference); the recorded JSON is, so bench.py can assert against the
+reference numbers without the binary present.
+"""
+import argparse
+import json
+import os
+import subprocess
+import sys
+import time
+
+import numpy as np
+
+sys.path.insert(0, os.path.dirname(os.path.dirname(os.path.abspath(__file__))))
+REPO = os.path.dirname(os.path.dirname(os.path.abspath(__file__)))
+
+
+def synth_higgs(n_rows, n_feat=28, seed=0):
+    sys.path.insert(0, REPO)
+    from bench import synth_higgs as sh
+    return sh(n_rows, n_feat, seed)
+
+
+def auc_np(y, p):
+    order = np.argsort(p, kind="mergesort")
+    y_s = y[order]
+    n_pos = y_s.sum()
+    n_neg = len(y_s) - n_pos
+    if n_pos == 0 or n_neg == 0:
+        return 0.5
+    # rank-sum with midrank ties
+    ranks = np.empty(len(p))
+    p_s = p[order]
+    i = 0
+    while i < len(p_s):
+        j = i
+        while j + 1 < len(p_s) and p_s[j + 1] == p_s[i]:
+            j += 1
+        ranks[i: j + 1] = 0.5 * (i + j) + 1.0
+        i = j + 1
+    return float((ranks[y_s == 1].sum() - n_pos * (n_pos + 1) / 2)
+                 / (n_pos * n_neg))
+
+
+def write_tsv(path, X, y):
+    data = np.column_stack([y, X]).astype(np.float32)
+    np.savetxt(path, data, fmt="%.7g", delimiter="\t")
+
+
+def train_reference(cli, workdir, train_path, valid_path, leaves, bins, iters,
+                    lr, threads=0):
+    conf = os.path.join(workdir, "ref_train.conf")
+    model = os.path.join(workdir, "ref_model.txt")
+    lines = [
+        "task=train", "objective=binary", f"data={train_path}",
+        f"num_leaves={leaves}", f"max_bin={bins}", f"num_iterations={iters}",
+        f"learning_rate={lr}", "min_data_in_leaf=20", "metric=auc",
+        f"output_model={model}", "verbosity=1",
+    ]
+    if threads:
+        lines.append(f"num_threads={threads}")
+    with open(conf, "w") as fh:
+        fh.write("\n".join(lines) + "\n")
+    t0 = time.time()
+    subprocess.run([cli, f"config={conf}"], check=True, cwd=workdir,
+                   stdout=subprocess.DEVNULL, stderr=subprocess.STDOUT)
+    train_time = time.time() - t0
+    # predict raw scores on train + valid
+    preds = {}
+    for tag, path in (("train", train_path), ("valid", valid_path)):
+        pconf = os.path.join(workdir, f"ref_pred_{tag}.conf")
+        out = os.path.join(workdir, f"ref_pred_{tag}.txt")
+        with open(pconf, "w") as fh:
+            fh.write("\n".join([
+                "task=predict", f"data={path}", f"input_model={model}",
+                f"output_result={out}", "predict_raw_score=false",
+            ]) + "\n")
+        subprocess.run([cli, f"config={pconf}"], check=True, cwd=workdir,
+                       stdout=subprocess.DEVNULL, stderr=subprocess.STDOUT)
+        preds[tag] = np.loadtxt(out)
+    return preds, train_time
+
+
+def train_tpu(X, y, Xv, yv, leaves, bins, iters, lr):
+    import jax
+    import lightgbm_tpu as lgb
+    params = {"objective": "binary", "num_leaves": leaves, "max_bin": bins,
+              "learning_rate": lr, "min_data_in_leaf": 20, "verbosity": -1,
+              "metric": "auc"}
+    t0 = time.time()
+    ds = lgb.Dataset(X, label=y, params=params)
+    ds.construct()
+    bin_time = time.time() - t0
+    booster = lgb.Booster(params=params, train_set=ds)
+    t0 = time.time()
+    for it in range(iters):
+        booster.update()
+        if (it + 1) % 50 == 0:
+            # bound the async dispatch queue: hundreds of in-flight tree
+            # programs through the tunneled runtime can crash the worker
+            jax.block_until_ready(booster.raw_train_score())
+    jax.block_until_ready(booster.raw_train_score())
+    train_time = time.time() - t0
+    p_train = 1.0 / (1.0 + np.exp(-np.asarray(booster.raw_train_score())))
+    p_valid = booster.predict(Xv)
+    return p_train, np.asarray(p_valid), train_time, bin_time
+
+
+def main():
+    ap = argparse.ArgumentParser()
+    ap.add_argument("--rows", type=int, default=1_000_000)
+    ap.add_argument("--valid-rows", type=int, default=200_000)
+    ap.add_argument("--iters", type=int, default=500)
+    ap.add_argument("--leaves", type=int, default=255)
+    ap.add_argument("--bins", type=int, default=63)
+    ap.add_argument("--lr", type=float, default=0.1)
+    ap.add_argument("--ref-cli", default=os.path.join(REPO, ".refbuild", "lightgbm"))
+    ap.add_argument("--out", default=os.path.join(REPO, "PARITY_BENCH.json"))
+    ap.add_argument("--workdir", default="/tmp/lgbm_parity")
+    ap.add_argument("--skip-tpu", action="store_true",
+                    help="only record reference numbers")
+    ap.add_argument("--skip-ref", action="store_true",
+                    help="only run the TPU side (ref numbers must exist)")
+    args = ap.parse_args()
+
+    os.makedirs(args.workdir, exist_ok=True)
+    X, y = synth_higgs(args.rows + args.valid_rows)
+    Xv, yv = X[args.rows:], y[args.rows:]
+    X, y = X[:args.rows], y[:args.rows]
+
+    out = {"entries": [], "parity": {}}
+    if os.path.exists(args.out):
+        with open(args.out) as fh:
+            out = json.load(fh)
+
+    key = {"rows": args.rows, "iters": args.iters, "leaves": args.leaves,
+           "bins": args.bins}
+    entry = next((e for e in out["entries"]
+                  if all(e.get(k) == v for k, v in key.items())), None)
+
+    if not args.skip_ref:
+        train_path = os.path.join(args.workdir, f"train_{args.rows}.tsv")
+        valid_path = os.path.join(args.workdir, f"valid_{args.valid_rows}.tsv")
+        if not os.path.exists(train_path):
+            print(f"writing {train_path} ...", file=sys.stderr)
+            write_tsv(train_path, X, y)
+        if not os.path.exists(valid_path):
+            write_tsv(valid_path, Xv, yv)
+        print("training reference CLI ...", file=sys.stderr)
+        preds, ref_time = train_reference(
+            args.ref_cli, args.workdir, train_path, valid_path,
+            args.leaves, args.bins, args.iters, args.lr)
+        entry = dict(key)
+        entry["ref_train_auc"] = round(auc_np(y, preds["train"]), 6)
+        entry["ref_valid_auc"] = round(auc_np(yv, preds["valid"]), 6)
+        entry["ref_train_time_s"] = round(ref_time, 1)
+        out["entries"] = [e for e in out["entries"]
+                          if not all(e.get(k) == v for k, v in key.items())]
+        out["entries"].append(entry)
+        print(f"reference: train_auc={entry['ref_train_auc']} "
+              f"valid_auc={entry['ref_valid_auc']} time={ref_time:.1f}s",
+              file=sys.stderr)
+        with open(args.out, "w") as fh:   # persist before the TPU phase
+            json.dump(out, fh, indent=1)
+
+    if not args.skip_tpu:
+        if entry is None:
+            sys.exit("no reference entry for this config; run without --skip-ref")
+        print("training lightgbm_tpu ...", file=sys.stderr)
+        p_train, p_valid, tpu_time, bin_time = train_tpu(
+            X, y, Xv, yv, args.leaves, args.bins, args.iters, args.lr)
+        tpu_train_auc = auc_np(y, p_train)
+        tpu_valid_auc = auc_np(yv, p_valid)
+        delta = abs(tpu_valid_auc - entry["ref_valid_auc"])
+        out["parity"] = {
+            **key,
+            "ref_valid_auc": entry["ref_valid_auc"],
+            "tpu_valid_auc": round(tpu_valid_auc, 6),
+            "tpu_train_auc": round(tpu_train_auc, 6),
+            "ref_train_auc": entry["ref_train_auc"],
+            "delta_valid_auc": round(delta, 6),
+            "ref_train_time_s": entry["ref_train_time_s"],
+            "tpu_train_time_s": round(tpu_time, 1),
+            "tpu_bin_time_s": round(bin_time, 1),
+        }
+        print(f"tpu: train_auc={tpu_train_auc:.6f} valid_auc={tpu_valid_auc:.6f} "
+              f"time={tpu_time:.1f}s (ref {entry['ref_train_time_s']}s) "
+              f"|delta_valid|={delta:.6f}", file=sys.stderr)
+        assert delta < 0.005, f"AUC parity FAILED: |delta|={delta:.6f} >= 0.005"
+
+    with open(args.out, "w") as fh:
+        json.dump(out, fh, indent=1)
+    print(json.dumps(out.get("parity") or out["entries"][-1]))
+
+
+if __name__ == "__main__":
+    main()

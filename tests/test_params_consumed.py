@@ -1,0 +1,147 @@
+"""Param consumption tests (VERDICT r3 missing #2/#3 + next #5).
+
+1. max_bin_by_feature changes binning per feature (reference: config.h:502,
+   validated like dataset.cpp:407-411).
+2. feature_contri multiplies per-feature split gain (reference:
+   dataset.cpp:394-400 feature_penalty_ + feature_histogram.hpp:89).
+3. Registry sweep: every registered param is CONSUMED somewhere outside the
+   config module (or sits on the explicit not-implemented/meta list below) —
+   the round-1 rule "never silently ignore a param", made enforceable.
+"""
+import os
+import re
+
+import numpy as np
+import pytest
+
+import lightgbm_tpu as lgb
+from lightgbm_tpu.config import _PARAMS
+from lightgbm_tpu.utils.log import LightGBMError
+
+
+def _make_binary(n=2000, f=5, seed=3):
+    rng = np.random.RandomState(seed)
+    X = rng.randn(n, f)
+    # feature 0 is by far the most informative
+    logits = 3.0 * X[:, 0] + 0.3 * X[:, 1]
+    y = (rng.rand(n) < 1 / (1 + np.exp(-logits))).astype(np.float64)
+    return X, y
+
+
+# ---- max_bin_by_feature ----
+
+def test_max_bin_by_feature_budgets():
+    X, y = _make_binary()
+    ds = lgb.Dataset(X, label=y, params={"max_bin": 63,
+                                         "max_bin_by_feature": [4, 63, 8, 63, 63]})
+    ds.construct()
+    nb = [m.num_bins for m in ds.mappers]
+    assert nb[0] <= 4 and nb[2] <= 8
+    # unbudgeted features got more bins than the tightly budgeted one
+    assert nb[1] > nb[0] and nb[3] > nb[2]
+
+    ds_plain = lgb.Dataset(X, label=y, params={"max_bin": 63})
+    ds_plain.construct()
+    assert ds_plain.mappers[0].num_bins > 4  # budget actually changed binning
+
+
+def test_max_bin_by_feature_validation():
+    X, y = _make_binary()
+    with pytest.raises(LightGBMError):
+        lgb.Dataset(X, label=y,
+                    params={"max_bin_by_feature": [4, 8]}).construct()
+    with pytest.raises(LightGBMError):
+        lgb.Dataset(X, label=y,
+                    params={"max_bin_by_feature": [1, 8, 8, 8, 8]}).construct()
+
+
+# ---- feature_contri ----
+
+def _split_features(bst):
+    feats = set()
+    for t in bst._ensure_host_trees():
+        feats.update(int(v) for v in np.asarray(t.split_feature)[
+            : max(0, t.num_leaves - 1)])
+    return feats
+
+
+def test_feature_contri_zero_blocks_feature():
+    X, y = _make_binary()
+    base = {"objective": "binary", "num_leaves": 8, "verbosity": -1,
+            "min_data_in_leaf": 5, "enable_bundle": False}
+    bst = lgb.train(base, lgb.Dataset(X, label=y), num_boost_round=5)
+    assert 0 in _split_features(bst), "sanity: feature 0 should dominate"
+
+    params = dict(base, feature_contri=[0.0, 1.0, 1.0, 1.0, 1.0])
+    bst0 = lgb.train(params, lgb.Dataset(X, label=y), num_boost_round=5)
+    assert 0 not in _split_features(bst0), \
+        "feature_contri=0 must make feature 0 unsplittable"
+
+
+def test_feature_contri_all_ones_is_noop():
+    X, y = _make_binary()
+    base = {"objective": "binary", "num_leaves": 8, "verbosity": -1,
+            "min_data_in_leaf": 5, "enable_bundle": False}
+    a = lgb.train(base, lgb.Dataset(X, label=y), num_boost_round=3)
+    b = lgb.train(dict(base, feature_contri=[1.0] * 5),
+                  lgb.Dataset(X, label=y), num_boost_round=3)
+    np.testing.assert_allclose(a.predict(X[:100]), b.predict(X[:100]),
+                               rtol=1e-6)
+
+
+def test_feature_contri_downweight_changes_choice():
+    # a mild penalty on the dominant feature should shift some splits away
+    X, y = _make_binary()
+    base = {"objective": "binary", "num_leaves": 8, "verbosity": -1,
+            "min_data_in_leaf": 5, "enable_bundle": False}
+    bst = lgb.train(dict(base, feature_contri=[0.01, 1.0, 1.0, 1.0, 1.0]),
+                    lgb.Dataset(X, label=y), num_boost_round=5)
+    f = _split_features(bst)
+    assert f and f != {0}
+
+
+def test_feature_contri_length_mismatch_fatal():
+    X, y = _make_binary()
+    with pytest.raises(LightGBMError):
+        lgb.train({"objective": "binary", "verbosity": -1,
+                   "feature_contri": [0.5, 1.0]},
+                  lgb.Dataset(X, label=y), num_boost_round=1)
+
+
+# ---- registry sweep ----
+
+# Params that are registered for API compatibility but intentionally NOT
+# consumed outside config.py. Every entry needs a reason; adding a param to
+# the registry without consuming it anywhere else fails the sweep unless it
+# is justified here.
+_EXPLICIT_NOT_CONSUMED = {
+    # parsed into Config and fanned out to per-subsystem seeds in config.py
+    "seed",
+    # CLI/meta params consumed by Config itself (task routing, file lists)
+    "config",
+}
+
+
+def test_every_registered_param_is_consumed():
+    pkg = os.path.dirname(lgb.__file__)
+    blobs = []
+    for root, _dirs, files in os.walk(pkg):
+        for fn in files:
+            if fn.endswith(".py") and fn != "config.py":
+                with open(os.path.join(root, fn)) as fh:
+                    blobs.append(fh.read())
+    src = "\n".join(blobs)
+    missing = []
+    for name in _PARAMS:
+        if name in _EXPLICIT_NOT_CONSUMED:
+            continue
+        # consumed = attribute access (conf.name / config.name / c.name),
+        # dict/string use ("name"), or kwarg (name=)
+        pat = re.compile(r"\.\s*" + re.escape(name) + r"\b|[\"']"
+                         + re.escape(name) + r"[\"']|\b" + re.escape(name)
+                         + r"\s*=")
+        if not pat.search(src):
+            missing.append(name)
+    assert not missing, (
+        f"registered but never consumed outside config.py: {missing} — "
+        f"implement them or add to _EXPLICIT_NOT_CONSUMED with a reason")

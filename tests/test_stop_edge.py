@@ -1,0 +1,77 @@
+"""Stop-detection edge cases (VERDICT r3 weak #7 / next #10): the 8-deep
+lagged finished-check queue interacting with rollback_one_iter.
+
+A rollback pops an iteration's trees while the queue still holds that
+iteration's leaf counts; a later aged-out all-stump entry must NOT pop trees
+whose score deltas remain baked into train/valid scores."""
+import numpy as np
+import pytest
+
+import lightgbm_tpu as lgb
+
+
+def _consistent(booster, X):
+    """Device train_score must equal an independent re-prediction of the
+    model over the raw features (pseudo-bin routing)."""
+    raw_dev = np.asarray(booster.raw_train_score())
+    raw_pred = booster.predict(X, raw_score=True)
+    np.testing.assert_allclose(raw_dev, raw_pred, rtol=1e-4, atol=1e-5)
+
+
+def _finished_booster():
+    # tiny, perfectly separable data: trees stop finding splits after a few
+    # iterations, so the pending queue fills with all-stump leaf counts
+    rng = np.random.RandomState(7)
+    X = rng.randn(60, 3)
+    y = (X[:, 0] > 0).astype(np.float64)
+    ds = lgb.Dataset(X, label=y, free_raw_data=False)
+    bst = lgb.Booster(params={"objective": "binary", "num_leaves": 4,
+                              "min_data_in_leaf": 5, "verbosity": -1,
+                              "learning_rate": 0.5}, train_set=ds)
+    return bst, X, y
+
+
+def test_rollback_with_pending_stop_queue():
+    bst, X, y = _finished_booster()
+    for _ in range(14):            # > queue depth 8: stump entries age out
+        bst.update()
+    n_before = bst._gbdt.iter_
+    assert n_before >= 2
+    bst.rollback_one_iter()
+    bst.rollback_one_iter()
+    assert bst._gbdt.iter_ == n_before - 2
+    # continue training after the rollback; aged stump entries from before
+    # the rollback must not pop live trees or corrupt scores
+    for _ in range(4):
+        bst.update()
+    _consistent(bst, X)
+    # model still predicts the separable problem
+    p = bst.predict(X)
+    assert ((p > 0.5) == (y > 0.5)).mean() > 0.95
+
+
+def test_rollback_then_finish_training_flush():
+    bst, X, y = _finished_booster()
+    for _ in range(14):
+        bst.update()
+    bst.rollback_one_iter()
+    for _ in range(3):
+        bst.update()
+    bst._gbdt.finish_training()    # drains the queue (engine.train loop end)
+    trees = bst._ensure_host_trees()
+    # after the drain, the model never ends in a stump run
+    assert not trees or trees[-1].num_leaves > 1
+    _consistent(bst, X)
+
+
+def test_save_midtraining_keeps_scores(tmp_path):
+    """finalize() for a mid-training save must not pop queued stumps whose
+    deltas are baked into the continuing training state."""
+    bst, X, y = _finished_booster()
+    for _ in range(10):
+        bst.update()
+    p = tmp_path / "mid.txt"
+    bst.save_model(str(p))
+    for _ in range(3):
+        bst.update()
+    _consistent(bst, X)
