@@ -699,63 +699,24 @@ class Booster:
             self._pseudo_router = router
         pbins = jax.device_put(router.bin_matrix(x))  # not jnp.asarray: see _finish_device
         na_dev = jnp.asarray(router.na_id)
-        stack_dev = {kk: jnp.asarray(v) for kk, v in router.stack.items()}
         if pred_leaf:
+            stack_dev = {kk: jnp.asarray(v) for kk, v in router.stack.items()}
             out = P.leaf_bins_ensemble(stack_dev, pbins, na_dev,
                                        router.max_steps)
             return np.asarray(out)
-        if k == 1:
-            raw = np.asarray(P.predict_bins_ensemble(
-                stack_dev, pbins, na_dev, router.max_steps), dtype=np.float64)
-            if self._avg_output():
-                raw = raw / len(trees)
-        else:
-            raw = np.zeros((n, k))
-            for cls in range(k):
-                sub = {kk: v[cls::k] for kk, v in stack_dev.items()}
-                raw[:, cls] = np.asarray(P.predict_bins_ensemble(
-                    sub, pbins, na_dev, router.max_steps))
-            if self._avg_output():
-                raw = raw / (len(trees) // k)
+        # dense path-matrix predictor when no categorical nodes, walk
+        # otherwise (ops/predict.py ensemble_raw_scores). exact_f32:
+        # pseudo-bin ids can exceed 256, past bf16's exact-integer range
+        raw = P.ensemble_raw_scores(
+            router.dense_tables(), router.stack, pbins, na_dev, k,
+            len(trees), self._avg_output(), exact_f32=True,
+            max_steps=router.max_steps)
         if raw_score:
             return raw
         obj = self._objective_for_predict()
         if obj is not None:
             return np.asarray(obj.convert_output(jnp.asarray(raw)))
         return raw
-
-    def _predict_binned(self, x: np.ndarray, trees, k: int) -> np.ndarray:
-        """Predict by binning the input with the training mappers and routing in
-        bin space — exactly the training-time semantics (needed for categorical
-        features, whose bins are count-ordered)."""
-        ts = self.train_set
-        used = ts.feature_map
-        bins = np.zeros((x.shape[0], len(ts.mappers)), dtype=np.uint8)
-        for j, m in enumerate(ts.mappers):
-            bins[:, j] = m.values_to_bins(x[:, int(used[j])]).astype(np.uint8)
-        inv = {int(o): j for j, o in enumerate(used)}
-        stack = stack_trees(trees, len(ts.mappers), ts.max_num_bins)
-        # remap node features from original to used-column space
-        for ti, t in enumerate(trees):
-            for ni in range(t.num_leaves - 1):
-                stack["split_feature"][ti, ni] = inv.get(int(t.split_feature[ni]), 0)
-        stack_dev = {kk: jnp.asarray(v) for kk, v in stack.items()}
-        bins_dev = jnp.asarray(bins)
-        max_steps = max(int(stack["num_leaves"].max()) - 1, 1)
-        if k == 1:
-            raw = P.predict_bins_ensemble(stack_dev, bins_dev, ts.na_bin_dev, max_steps)
-            raw = np.asarray(raw, dtype=np.float64)
-            if self._avg_output():
-                raw = raw / len(trees)
-            return raw
-        out = np.zeros((x.shape[0], k))
-        for cls in range(k):
-            sub = {kk: v[cls::k] for kk, v in stack_dev.items()}
-            out[:, cls] = np.asarray(P.predict_bins_ensemble(
-                sub, bins_dev, ts.na_bin_dev, max_steps))
-        if self._avg_output():
-            out = out / (len(trees) // k)
-        return out
 
     def _avg_output(self) -> bool:
         if self._gbdt is not None:

@@ -198,18 +198,11 @@ def _predict_via_trees(init_booster: Booster, dataset) -> np.ndarray:
             sf[ti, ni] = used
     stacked["split_feature"] = sf
     stacked["threshold_bin"] = tb
-    stack_dev = {kk: jnp.asarray(v) for kk, v in stacked.items()}
-    max_steps = max(int(stacked["num_leaves"].max()) - 1, 1)
-    out = P.predict_bins_ensemble(stack_dev, dataset.bins, dataset.na_bin_dev, max_steps)
-    if k > 1:
-        # per-class: route class trees separately
-        outs = []
-        for cls in range(k):
-            sub = {kk: v[cls::k] for kk, v in stack_dev.items()}
-            outs.append(P.predict_bins_ensemble(sub, dataset.bins,
-                                                dataset.na_bin_dev, max_steps))
-        return _np.stack([_np.asarray(o) for o in outs], axis=1)
-    return _np.asarray(out)
+    from .models.tree import ensemble_max_depth, ensemble_path_tables
+    dense = ensemble_path_tables(stacked, _np.asarray(dataset.na_bin_dev))
+    return P.ensemble_raw_scores(
+        dense, stacked, dataset.bins, dataset.na_bin_dev, k,
+        len(trees), avg=False, max_steps=ensemble_max_depth(stacked))
 
 
 def cv(params: Dict[str, Any], train_set: Dataset, num_boost_round: int = 100,

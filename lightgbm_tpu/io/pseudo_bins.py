@@ -98,7 +98,18 @@ class PseudoRouter:
                     idx = int(np.searchsorted(self.thr_sorted[f],
                                               t.threshold_real[i]))
                     self.stack["threshold_bin"][ti, i] = idx
-        self.max_steps = max(int(self.stack["num_leaves"].max()) - 1, 1)
+        from ..models.tree import ensemble_max_depth
+        self.max_steps = ensemble_max_depth(self.stack)
+        self._dense = False           # built lazily by dense_tables()
+
+    def dense_tables(self):
+        """Cached signed-path tables for the gather-free dense predictor
+        (models/tree.py ensemble_path_tables); None when categorical nodes
+        force the walk path."""
+        if self._dense is False:
+            from ..models.tree import ensemble_path_tables
+            self._dense = ensemble_path_tables(self.stack, self.na_id)
+        return self._dense
 
     def bin_matrix(self, x: np.ndarray) -> np.ndarray:
         """[N, F] f64 raw features -> [N, F] i32 pseudo-bins (host, exact)."""

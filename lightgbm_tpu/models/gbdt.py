@@ -944,12 +944,19 @@ class GBDT:
 
     # ---- model finalize / predict ----
     def finalize(self) -> List[Tree]:
-        """Convert remaining device trees to host Trees."""
+        """Convert remaining device trees to host Trees.
+
+        ONE batched jax.device_get for all pending trees: per-field
+        np.asarray readbacks cost a tunnel round-trip each (~15 fields x
+        T trees serialized at ~50-100 ms apiece made finalizing a 500-tree
+        model take minutes and could crash the tunneled worker)."""
         ts = self.train_set
-        while len(self.models_host) < len(self.models_dev):
-            i = len(self.models_host)
-            t = Tree.from_device(jax.tree_util.tree_map(np.asarray, self.models_dev[i]),
-                                 ts.mappers, ts.feature_map,
+        start = len(self.models_host)
+        if start >= len(self.models_dev):
+            return self.models_host
+        host_arrays = jax.device_get(self.models_dev[start:])
+        for arrs in host_arrays:
+            t = Tree.from_device(arrs, ts.mappers, ts.feature_map,
                                  bundle_meta=getattr(ts, "bundle_meta", None))
             t.shrinkage = self.learning_rate if not self.average_output else 1.0
             self.models_host.append(t)

@@ -438,3 +438,107 @@ def stack_trees(trees: List[Tree], num_features: int, max_num_bins: int,
             bsz = min(tr.cat_mask_bins.shape[1], max_num_bins)
             out["cat_mask"][i, :n_int, :bsz] = tr.cat_mask_bins[:, :bsz]
     return out
+
+
+def ensemble_path_tables(stack: Dict[str, np.ndarray],
+                         na_of_feature: np.ndarray) -> Optional[Dict[str, np.ndarray]]:
+    """Signed path matrices for the dense (gather-free) ensemble predictor
+    (ops/predict.py predict_bins_ensemble_dense).
+
+    The classic per-row tree WALK is a sequential chain of data-dependent
+    gathers — the worst possible shape for the TPU (the reference walks
+    pointers per row, tree.h:240; fine on CPU). Instead: decide EVERY node of
+    a tree at once (one one-hot matmul per tree group), then resolve each
+    row's leaf with a signed path matrix A [L, M] (+1 = path goes left at
+    node m, -1 = right, 0 = node off-path): a row lands in leaf l iff
+    A[l] . sign(decisions) == path_length[l]. Three batched MXU contractions
+    replace depth x 4 sequential gathers.
+
+    Returns None if any tree has categorical nodes (caller falls back to the
+    walk; subset membership is not a threshold compare)."""
+    if np.asarray(stack.get("is_cat", np.zeros(1, bool))).any():
+        return None
+    lc = np.asarray(stack["left_child"])
+    rc = np.asarray(stack["right_child"])
+    nl = np.asarray(stack["num_leaves"])
+    feat = np.asarray(stack["split_feature"])
+    t_cnt, m = lc.shape
+    l_max = np.asarray(stack["leaf_value"]).shape[1]
+    A = np.zeros((t_cnt, l_max, m), dtype=np.int8)
+    plen = np.full((t_cnt, l_max), -1.0, dtype=np.float32)
+    m_idx = np.arange(m)
+    lrows = np.arange(l_max)
+    for i in range(t_cnt):
+        n_int = max(int(nl[i]) - 1, 0)
+        if n_int == 0:
+            plen[i, 0] = 0.0          # stump: every row is in leaf 0
+            continue
+        live = m_idx < n_int
+        par = np.full(m, -1, dtype=np.int64)
+        psign = np.zeros(m, dtype=np.int8)
+        for ch_arr, s in ((lc[i], 1), (rc[i], -1)):
+            mk = live & (ch_arr >= 0)
+            par[ch_arr[mk]] = m_idx[mk]
+            psign[ch_arr[mk]] = s
+        leaf_par = np.full(l_max, -1, dtype=np.int64)
+        leaf_sign = np.zeros(l_max, dtype=np.int8)
+        for ch_arr, s in ((lc[i], 1), (rc[i], -1)):
+            mk = live & (ch_arr < 0)
+            leaves = ~ch_arr[mk]
+            leaf_par[leaves] = m_idx[mk]
+            leaf_sign[leaves] = s
+        cur, sgn = leaf_par.copy(), leaf_sign.copy()
+        while (cur >= 0).any():
+            v = cur >= 0
+            A[i][lrows[v], cur[v]] = sgn[v]
+            safe = np.maximum(cur, 0)
+            cur, sgn = np.where(v, par[safe], -1), np.where(v, psign[safe], 0)
+        plen[i, : int(nl[i])] = np.abs(
+            A[i][: int(nl[i])].astype(np.int32)).sum(axis=1)
+    nav = np.asarray(na_of_feature, np.float32)[feat]     # [T, M]
+    return {
+        "feat": feat.astype(np.int32),
+        "thr": np.asarray(stack["threshold_bin"], np.float32),
+        "dleft": np.asarray(stack["default_left"], np.float32),
+        "nav": nav,
+        "A": A,
+        "plen": plen,
+        "lv": np.asarray(stack["leaf_value"], np.float32),
+    }
+
+
+def ensemble_max_depth(stack: Dict[str, np.ndarray]) -> int:
+    """Longest root->leaf DECISION count across stacked trees (host-side).
+
+    The jitted tree walk (ops/predict.py route_bins) runs a static-trip
+    loop; sizing it by num_leaves - 1 (254 at L=255) instead of the actual
+    depth (~10 for depthwise trees) made batch prediction ~25x slower and
+    could stall the tunneled runtime outright. Children always carry larger
+    node ids than their parents (both growers assign ids split-/level-
+    ordered), so one forward pass over nodes computes exact depths."""
+    lc = np.asarray(stack["left_child"])
+    rc = np.asarray(stack["right_child"])
+    nl = np.asarray(stack["num_leaves"])
+    t_cnt, m = lc.shape
+    if t_cnt == 0:
+        return 1
+    node_iota = np.arange(m)[None, :]
+    if (((lc >= 0) & (lc <= node_iota)) | ((rc >= 0) & (rc <= node_iota))).any():
+        # non-monotone node ordering (foreign model file): conservative bound
+        return int(max(1, nl.max() - 1))
+    depth = np.zeros((t_cnt, m), dtype=np.int32)
+    depth[:, 0] = (nl > 1).astype(np.int32)
+    best = depth[:, 0].copy()
+    rows = np.arange(t_cnt)
+    for t in range(m):
+        d = depth[:, t]
+        active = d > 0
+        if not active.any():
+            continue
+        best = np.maximum(best, d)
+        for ch in (lc[:, t], rc[:, t]):
+            valid = active & (ch > t) & (ch < m)
+            idx = np.where(valid, ch, 0)
+            nd = np.where(valid, d + 1, 0)
+            np.maximum.at(depth, (rows, idx), nd)
+    return int(max(1, best.max()))

@@ -120,7 +120,12 @@ def grow_tree_depthwise(bins: jnp.ndarray, g: jnp.ndarray, h: jnp.ndarray,
     # unlimited depth => up to L-1 levels; the loop exits as soon as a level
     # selects no splits, so balanced trees still cost ~log2(L) passes
     max_levels = gp.max_depth if gp.max_depth > 0 else max(1, L - 1)
-    MAX_SLOTS = (L + 1) // 2 + 1 if L > 2 else 2  # max splits in one level + 1
+    # max splits any level can SELECT: min(frontier 2^d, budget L - 2^d) peaks
+    # at L // 2. The dropped-row slot id equals the slot count (no weight row
+    # in the kernel), so the pass width is exactly the split cap — at L=255
+    # the deepest pass is S=127 -> 381 lanes -> 384 MXU-lane pad, vs the old
+    # cap+1 = 129 -> 387 -> 512 lanes (+33% MXU on the deepest level)
+    MAX_SLOTS = max(1, L // 2)
 
     # pallas kernels read a transposed bin matrix; build it ONCE per tree (XLA
     # CSEs it across all level passes inside this jit)
@@ -367,14 +372,18 @@ def grow_tree_depthwise(bins: jnp.ndarray, g: jnp.ndarray, h: jnp.ndarray,
         # ---- budgeted selection (num_leaves cap): top-gain candidates win.
         # rank by pairwise comparison count instead of argsort — an [L] sort
         # on TPU costs milliseconds; the [L, L] compare matrix is microseconds
-        cand = st.active & (res.gain > jnp.maximum(sp.min_gain_to_split, 0.0)) \
-            & (res.gain > NEG_INF / 2)
+        # in feature_contri mode res.gain is already the PENALIZED improvement
+        # with min_gain_to_split subtracted (split.py best_split) — gating it
+        # against min_gain again would apply the threshold twice
+        gain_gate = 0.0 if sp.has_contri \
+            else float(max(sp.min_gain_to_split, 0.0))
+        cand = st.active & (res.gain > gain_gate) & (res.gain > NEG_INF / 2)
         budget = L - st.tree.num_leaves
         key = jnp.where(cand, res.gain, -jnp.inf)
         kj, ki = key[None, :], key[:, None]
         better = (kj > ki) | ((kj == ki) & (leaves_iota[None, :] < leaves_iota[:, None]))
         rank = jnp.sum(better, axis=1).astype(jnp.int32)   # stable desc rank
-        sel = cand & (rank < jnp.minimum(budget, SLOTS - 1))
+        sel = cand & (rank < jnp.minimum(budget, SLOTS))
         num_sel = sel.sum().astype(jnp.int32)
 
         # assignment order within the level: by leaf index
@@ -624,7 +633,7 @@ def grow_tree_depthwise(bins: jnp.ndarray, g: jnp.ndarray, h: jnp.ndarray,
     n_unroll = min(max_levels, max(1, math.ceil(math.log2(max(L - 1, 2)))) + 1)
     last_sel = jnp.int32(1)
     for k in range(n_unroll):
-        slots_k = min(2 ** k, MAX_SLOTS - 1) + 1
+        slots_k = min(2 ** k, MAX_SLOTS)
         # early exit: once a level selects no splits OR the leaf budget is
         # exhausted, the tree is finished — skip the remaining unrolled
         # full-data passes. The budget check matters for balanced growth: a

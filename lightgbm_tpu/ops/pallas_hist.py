@@ -52,7 +52,9 @@ def _kernel(bins_ref, g_ref, h_ref, c_ref, slot_ref, out_ref, *,
     def _():
         out_ref[:] = jnp.zeros_like(out_ref)
 
-    # ---- one-hot in [Fg*B, C] lane layout: VPU only ----
+    # ---- one-hot in [Fg*B, C] lane layout: VPU only (int32 compares —
+    # Mosaic on v5e rejects sub-word vector cmpi: "Target does not support
+    # this comparison" on vector<...xi8>) ----
     bins_i = bins_ref[:].astype(jnp.int32)                      # [Fg, C]
     bb = jax.lax.broadcast_in_dim(bins_i, (fg, b, chunk), (0, 2))
     iota_b = jax.lax.broadcasted_iota(jnp.int32, (fg, b, chunk), 1)
@@ -187,6 +189,9 @@ def _kernel_q8(bins_ref, gq_ref, hq_ref, c_ref, slot_ref, out_ref, *,
     def _():
         out_ref[:] = jnp.zeros_like(out_ref)
 
+    # one-hot compares in int32 (Mosaic on v5e rejects sub-word vector cmpi;
+    # an int8-compare variant fails to compile with "Target does not support
+    # this comparison")
     bins_i = bins_ref[:].astype(jnp.int32)                      # [Fg, C]
     bb = jax.lax.broadcast_in_dim(bins_i, (fg, b, chunk), (0, 2))
     iota_b = jax.lax.broadcasted_iota(jnp.int32, (fg, b, chunk), 1)
@@ -270,6 +275,172 @@ def hist_pallas_q8(bins_T: jnp.ndarray, gq: jnp.ndarray, hq: jnp.ndarray,
     hist = jnp.stack([out[..., 0] * sg, out[..., 1] * sh, out[..., 2]],
                      axis=-1).transpose(2, 3, 0, 1)
     return hist[:, :, :f, :]
+
+
+def _kernel_q8_fused(*refs, f: int, b: int, s: int, l: int, chunk: int,
+                     has_cat: bool):
+    """Fused route + int8 histogram for ONE feature group (F*B <= block cap).
+
+    Per level the two-pass scheme reads the bin matrix twice (route kernel,
+    then histogram kernel) and round-trips the [N] slot vector through HBM;
+    at 10M rows the route pass alone measured 8.3 ms against the small-S
+    histogram floor of ~15 ms. This kernel routes the chunk in-register and
+    feeds the slot straight into the weight mask — one bins read, one launch.
+
+    refs: bins [F, C] u8; gq/hq/cq [C] i8; lid [C] i32; tabs [8, L] f32
+    (feat, thr, dleft, new_leaf, slot_left, slot_right, is_cat, _);
+    nab [F, 1] f32; [memT [B, L] f32 when has_cat]; outputs:
+    out [F*B, S*3] i32 accumulated, lid_out [C] i32.
+    """
+    if has_cat:
+        (bins_ref, gq_ref, hq_ref, cq_ref, lid_ref, tabs_ref, nab_ref,
+         memT_ref, out_ref, lid_out) = refs
+    else:
+        (bins_ref, gq_ref, hq_ref, cq_ref, lid_ref, tabs_ref, nab_ref,
+         out_ref, lid_out) = refs
+    i = pl.program_id(0)
+
+    @pl.when(i == 0)
+    def _():
+        out_ref[:] = jnp.zeros_like(out_ref)
+
+    # ---- route (see _route_kernel for the one-hot decode rationale) ----
+    lid = lid_ref[:].reshape(1, chunk)
+    iota_l = jax.lax.broadcasted_iota(jnp.int32, (l, chunk), 0)
+    oh = (lid == iota_l).astype(jnp.float32)                     # [L, C]
+    tv = jax.lax.dot_general(
+        tabs_ref[:], oh, dimension_numbers=(((1,), (0,)), ((), ())),
+        preferred_element_type=jnp.float32,
+        precision=jax.lax.Precision.HIGHEST)                     # [8, C]
+    feat, thr, dleft = tv[0:1], tv[1:2], tv[2:3]
+    new_leaf, slot_l, slot_r = tv[3:4], tv[4:5], tv[5:6]
+
+    bins_i = bins_ref[:].astype(jnp.int32)                       # [F, C]
+    bins_f = bins_i.astype(jnp.float32)
+    iota_f = jax.lax.broadcasted_iota(jnp.int32, (f, chunk), 0) \
+        .astype(jnp.float32)
+    fm = iota_f == feat
+    colv = jnp.sum(jnp.where(fm, bins_f, 0.0), axis=0, keepdims=True)
+    nav = jnp.sum(jnp.where(fm, nab_ref[:].astype(jnp.float32), 0.0),
+                  axis=0, keepdims=True)
+    has = jnp.where(feat >= 0, 1.0, 0.0)
+    is_na = jnp.where(colv == nav, 1.0, 0.0)
+    gr_na = jnp.where(dleft == 0, 1.0, 0.0)
+    gr_num = jnp.where(colv > thr, 1.0, 0.0)
+    go_right = is_na * gr_na + (1.0 - is_na) * gr_num
+    if has_cat:
+        mem_bc = jax.lax.dot_general(
+            memT_ref[:], oh, dimension_numbers=(((1,), (0,)), ((), ())),
+            preferred_element_type=jnp.float32)                  # [B, C]
+        iota_b1 = jax.lax.broadcasted_iota(jnp.int32, (b, chunk), 0) \
+            .astype(jnp.float32)
+        member = jnp.sum(jnp.where(iota_b1 == colv, mem_bc, 0.0),
+                         axis=0, keepdims=True)
+        iscat = tv[6:7]
+        go_right = iscat * (1.0 - member) + (1.0 - iscat) * go_right
+    lid2 = jnp.where(has * go_right > 0, new_leaf, lid)
+    slot_f = has * (go_right * slot_r + (1.0 - go_right) * slot_l) \
+        + (1.0 - has) * float(s)
+    lid_out[:] = lid2.astype(jnp.int32).reshape(chunk)
+    slot = jnp.minimum(slot_f.astype(jnp.int32), s)              # [1, C]
+
+    # ---- int8 histogram (see _kernel_q8) ----
+    bb = jax.lax.broadcast_in_dim(bins_i, (f, b, chunk), (0, 2))
+    iota_b = jax.lax.broadcasted_iota(jnp.int32, (f, b, chunk), 1)
+    onehot = (bb == iota_b).astype(jnp.int8).reshape(f * b, chunk)
+    g = gq_ref[:].reshape(1, chunk).astype(jnp.int32)
+    h = hq_ref[:].reshape(1, chunk).astype(jnp.int32)
+    c = cq_ref[:].reshape(1, chunk).astype(jnp.int32)
+    ghc = jnp.concatenate([g, h, c], axis=0)
+    w = jax.lax.broadcast_in_dim(ghc, (s, 3, chunk), (1, 2)) \
+        .reshape(s * 3, chunk)
+    slot_of_row = jax.lax.broadcasted_iota(jnp.int32, (s * 3, chunk), 0) // 3
+    w = jnp.where(slot == slot_of_row, w, 0).astype(jnp.int8)
+    part = jax.lax.dot_general(
+        onehot, w, dimension_numbers=(((1,), (1,)), ((), ())),
+        preferred_element_type=jnp.int32)
+    out_ref[:] += part
+
+
+def hist_routed_fused_q8(bins_T, gq, hq, cq, leaf_id, tables, na_bin,
+                         num_slots: int, num_bins: int, scale_g, scale_h,
+                         num_leaves: int, chunk: int = 0,
+                         interpret: bool = False):
+    """Fused route+histogram level pass. Returns ([S, 3, F, B] f32, lid2 [N]).
+
+    Only valid when every feature fits one accumulator block
+    (F * num_bins <= _ACC_ROWS_MAX) — the router must see ALL columns."""
+    f, n = bins_T.shape
+    b, s, l = num_bins, num_slots, num_leaves
+    assert f * b <= _ACC_ROWS_MAX
+    if chunk == 0:
+        # doubled chunk halves per-chunk fixed costs; at deep S the
+        # [S*3, C] weights + [FB, C] onehot + route blocks near the 16MB
+        # VMEM ceiling, so fall back to 2048
+        chunk = 4096 if s * 3 <= 192 else _CHUNK_Q8
+
+    has_cat = tables.is_cat is not None
+    iscat_row = (tables.is_cat.astype(jnp.float32) if has_cat
+                 else jnp.zeros(l, jnp.float32))
+    tabs = jnp.stack([
+        tables.feat.astype(jnp.float32), tables.thr.astype(jnp.float32),
+        tables.dleft.astype(jnp.float32), tables.new_leaf.astype(jnp.float32),
+        tables.slot_left.astype(jnp.float32),
+        tables.slot_right.astype(jnp.float32),
+        iscat_row, jnp.zeros(l, jnp.float32)])                    # [8, L]
+    nab = na_bin.astype(jnp.float32).reshape(f, 1)
+
+    bins_Tp = _pad_rows(bins_T, chunk)
+    gq = _pad_rows(gq, chunk)
+    hq = _pad_rows(hq, chunk)
+    cq = _pad_rows(cq, chunk)
+    lid_p = _pad_rows(leaf_id, chunk, value=l)  # padded rows: no leaf -> the
+    n_chunks = bins_Tp.shape[1] // chunk        # decode yields feat=-1 -> drop
+
+    in_specs = [
+        pl.BlockSpec((f, chunk), lambda i: (0, i), memory_space=pltpu.VMEM),
+        pl.BlockSpec((chunk,), lambda i: (i,), memory_space=pltpu.VMEM),
+        pl.BlockSpec((chunk,), lambda i: (i,), memory_space=pltpu.VMEM),
+        pl.BlockSpec((chunk,), lambda i: (i,), memory_space=pltpu.VMEM),
+        pl.BlockSpec((chunk,), lambda i: (i,), memory_space=pltpu.VMEM),
+        pl.BlockSpec((8, l), lambda i: (0, 0), memory_space=pltpu.VMEM),
+        pl.BlockSpec((f, 1), lambda i: (0, 0), memory_space=pltpu.VMEM),
+    ]
+    args = [bins_Tp, gq, hq, cq, lid_p, tabs, nab]
+    b_mem = tables.member.shape[1] if has_cat else 1
+    if has_cat:
+        in_specs.append(pl.BlockSpec((b_mem, l), lambda i: (0, 0),
+                                     memory_space=pltpu.VMEM))
+        args.append(tables.member.astype(jnp.float32).T)
+
+    kern = functools.partial(_kernel_q8_fused, f=f, b=b, s=s, l=l,
+                             chunk=chunk, has_cat=has_cat)
+    out, lid2 = pl.pallas_call(
+        kern,
+        grid=(n_chunks,),
+        in_specs=in_specs,
+        out_specs=(
+            pl.BlockSpec((f * b, s * 3), lambda i: (0, 0),
+                         memory_space=pltpu.VMEM),
+            pl.BlockSpec((chunk,), lambda i: (i,), memory_space=pltpu.VMEM),
+        ),
+        out_shape=(
+            jax.ShapeDtypeStruct((f * b, s * 3), jnp.int32),
+            jax.ShapeDtypeStruct((bins_Tp.shape[1],), jnp.int32),
+        ),
+        cost_estimate=pl.CostEstimate(
+            flops=2 * n * f * b * s * 3 + 2 * n * l * 9,
+            bytes_accessed=n * (f + 11) + f * b * s * 12,
+            transcendentals=0),
+        interpret=interpret,
+    )(*args)
+
+    out = out.reshape(f, b, s, 3).astype(jnp.float32)
+    sg = scale_g * jnp.float32(1.0 / 127.0)
+    sh = scale_h * jnp.float32(1.0 / 127.0)
+    hist = jnp.stack([out[..., 0] * sg, out[..., 1] * sh, out[..., 2]],
+                     axis=-1).transpose(2, 3, 0, 1)
+    return hist, lid2[:n]
 
 
 def _leaf_sums_kernel(g_ref, h_ref, c_ref, lid_ref, out_ref, *,

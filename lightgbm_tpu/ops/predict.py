@@ -82,6 +82,110 @@ def route_raw(split_feature, threshold_real, default_left, left_child, right_chi
     return jnp.invert(jnp.minimum(ptr, -1))
 
 
+def ensemble_raw_scores(dense, stack, bins_dev, na_dev, k: int, n_trees: int,
+                        avg: bool, exact_f32: bool = False,
+                        max_steps: int = 1):
+    """Dense-or-walk ensemble dispatch shared by Booster.predict and the
+    warm-start predictor (engine._predict_via_trees): dense path-matrix
+    predictor when ``dense`` tables exist (no categorical nodes), the
+    depth-bounded walk otherwise; per-class [cls::k] slicing for multiclass;
+    ``avg`` divides by trees-per-class (RF average_output)."""
+    import numpy as _np
+
+    def one(tset, fn):
+        if k == 1:
+            raw = _np.asarray(fn(tset), dtype=_np.float64)
+            return raw / n_trees if avg else raw
+        out = _np.zeros((bins_dev.shape[0], k))
+        for cls in range(k):
+            sub = {kk: v[cls::k] for kk, v in tset.items()}
+            out[:, cls] = _np.asarray(fn(sub))
+        return out / (n_trees // k) if avg else out
+
+    if dense is not None:
+        dense_dev = {kk: jnp.asarray(v) for kk, v in dense.items()}
+        return one(dense_dev, lambda tset: predict_bins_ensemble_dense(
+            tset, bins_dev, exact_f32=exact_f32))
+    stack_dev = {kk: jnp.asarray(v) for kk, v in stack.items()}
+    return one(stack_dev, lambda tset: predict_bins_ensemble(
+        tset, bins_dev, na_dev, max_steps))
+
+
+@partial(jax.jit, static_argnames=("group", "row_chunk", "exact_f32"))
+def predict_bins_ensemble_dense(tables, bins, group: int = 8,
+                                row_chunk: int = 4096,
+                                exact_f32: bool = False):
+    """Gather-free ensemble prediction: [N] f32 raw scores.
+
+    TPU-native replacement for the per-row pointer walk (reference:
+    PredictRaw -> Tree::Predict node chase, gbdt_prediction.cpp:13 +
+    tree.h:240): every node of a tree GROUP is decided at once via a one-hot
+    feature contraction, and each row's leaf is resolved by the signed path
+    matrix built in models/tree.py ensemble_path_tables — three batched MXU
+    einsums per (tree-group, row-chunk), no sequential dependency, no
+    gathers. The walk-based predict of a 500-tree model stalled the tunneled
+    TPU runtime outright; this runs the same query as dense matmuls.
+
+    tables: dict from ensemble_path_tables (device-put by the caller);
+    bins: [N, F] uint8/int32 binned rows. ``exact_f32`` must be True when
+    bin values can exceed 256 (pseudo-bins) — bf16 one-hot contraction is
+    only exact below that.
+    """
+    n, f = bins.shape
+    t, m = tables["feat"].shape
+    l = tables["lv"].shape[1]
+    cdt = jnp.float32 if exact_f32 else jnp.bfloat16
+    prec = (jax.lax.Precision.HIGHEST if exact_f32
+            else jax.lax.Precision.DEFAULT)
+
+    t_pad = -(-t // group) * group
+    n_pad = -(-n // row_chunk) * row_chunk
+
+    def padt(x):
+        return jnp.pad(x, ((0, t_pad - t),) + ((0, 0),) * (x.ndim - 1))
+
+    feat_p = padt(tables["feat"]).reshape(-1, group, m)
+    thr_p = padt(tables["thr"]).reshape(-1, group, m)
+    dl_p = padt(tables["dleft"]).reshape(-1, group, m)
+    nav_p = padt(tables["nav"]).reshape(-1, group, m)
+    a_p = padt(tables["A"].astype(cdt)).reshape(-1, group, l, m)
+    # padded trees: plen stays -1 (impossible count) so no leaf matches
+    plen_p = jnp.pad(tables["plen"], ((0, t_pad - t), (0, 0)),
+                     constant_values=-1.0).reshape(-1, group, l)
+    lv_p = padt(tables["lv"]).reshape(-1, group, l)
+    # one-hot of each node's feature, built once (chunk-independent)
+    fo = (feat_p[..., None] == jnp.arange(f)[None, None, None, :]) \
+        .astype(cdt)                                      # [Gs, G, M, F]
+
+    bins_p = jnp.pad(bins, ((0, n_pad - n), (0, 0)))
+    chunks = bins_p.reshape(-1, row_chunk, f)
+
+    def per_chunk(_, bins_c):
+        binc = bins_c.T.astype(cdt)                       # [F, C]
+
+        def per_group(score, args):
+            fo_g, thr_g, dl_g, nav_g, a_g, plen_g, lv_g = args
+            colv = jnp.einsum("gmf,fc->gmc", fo_g, binc,
+                              preferred_element_type=jnp.float32,
+                              precision=prec)             # exact int values
+            dec = jnp.where(colv == nav_g[:, :, None], dl_g[:, :, None],
+                            (colv <= thr_g[:, :, None]).astype(jnp.float32))
+            sgn = (2.0 * dec - 1.0).astype(jnp.bfloat16)  # +-1, exact
+            cnt = jnp.einsum("glm,gmc->glc", a_g.astype(jnp.bfloat16), sgn,
+                             preferred_element_type=jnp.float32)
+            memb = (cnt == plen_g[:, :, None]).astype(jnp.float32)
+            score = score + jnp.einsum("gl,glc->c", lv_g, memb)
+            return score, None
+
+        score, _ = jax.lax.scan(
+            per_group, jnp.zeros(bins_c.shape[0], jnp.float32),
+            (fo, thr_p, dl_p, nav_p, a_p, plen_p, lv_p))
+        return None, score
+
+    _, out = jax.lax.scan(per_chunk, None, chunks)
+    return out.reshape(-1)[:n]
+
+
 @partial(jax.jit, static_argnames=("max_steps",))
 def predict_bins_ensemble(tree_stack, bins, na_bin, max_steps: int):
     """Sum of leaf values over a stacked ensemble, on binned data.

@@ -91,6 +91,21 @@ def _decode_mapper(row: np.ndarray) -> BinMapper:
     return m
 
 
+def _slice_mbf(max_bin_by_feature, f: int, lo: int, hi: int):
+    """Validate max_bin_by_feature against the FULL feature count before
+    slicing to this rank's feature range — the local slice always has the
+    right length, so a wrong-length config would otherwise pass silently
+    here while the serial path fatals (dataset.cpp:408 CHECK)."""
+    if not max_bin_by_feature:
+        return None
+    vals = list(max_bin_by_feature)
+    if len(vals) != f:
+        from ..utils import log
+        log.fatal(f"max_bin_by_feature has {len(vals)} entries but the data "
+                  f"has {f} features")
+    return vals[lo:hi]
+
+
 def find_bin_mappers_distributed(
     raw_local: np.ndarray,
     max_bin: int,
@@ -126,8 +141,7 @@ def find_bin_mappers_distributed(
         seed=seed + rank,
         forced_bins={k - lo: v for k, v in (forced_bins or {}).items()
                      if lo <= k < hi},
-        max_bin_by_feature=(list(max_bin_by_feature)[lo:hi]
-                            if max_bin_by_feature else None))
+        max_bin_by_feature=_slice_mbf(max_bin_by_feature, f, lo, hi))
 
     width = _HDR + max(max_bin, *(max_bin_by_feature or [0])) + 2
     enc = np.zeros((f, width), dtype=np.float64)

@@ -114,12 +114,19 @@ def train_tpu(X, y, Xv, yv, leaves, bins, iters, lr):
     t0 = time.time()
     for it in range(iters):
         booster.update()
-        if (it + 1) % 50 == 0:
+        if (it + 1) % 10 == 0:
+            # 10, not 50: ~50 queued iterations (hundreds of in-flight
+            # programs) reproducibly crash the tunneled TPU worker
             # bound the async dispatch queue: hundreds of in-flight tree
             # programs through the tunneled runtime can crash the worker
             jax.block_until_ready(booster.raw_train_score())
+        if (it + 1) % 100 == 0:
+            print(f"  iter {it + 1}/{iters} t={time.time() - t0:.1f}s",
+                  file=sys.stderr, flush=True)
     jax.block_until_ready(booster.raw_train_score())
     train_time = time.time() - t0
+    print(f"  train done {train_time:.1f}s; predicting valid ...",
+          file=sys.stderr, flush=True)
     p_train = 1.0 / (1.0 + np.exp(-np.asarray(booster.raw_train_score())))
     p_valid = booster.predict(Xv)
     return p_train, np.asarray(p_valid), train_time, bin_time

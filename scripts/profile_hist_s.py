@@ -1,0 +1,94 @@
+"""Per-phase device timings of the depthwise level machinery vs slot count.
+
+Measures (time_op_in_jit, real TPU):
+  - hist_pallas_q8 at S in {2, 9, 33, 64, 65, 128, 129} (lane-padding study:
+    S*3 pads to 128-lane multiples on the MXU, so 129 -> 512 lanes while
+    128 -> 384)
+  - route_level_pallas
+  - best_split over the [L, 3, F, B] frontier
+
+Usage: python scripts/profile_hist_s.py [rows] [feat] [bins]
+"""
+import os
+import sys
+
+sys.path.insert(0, os.path.dirname(os.path.dirname(os.path.abspath(__file__))))
+
+import jax
+import jax.numpy as jnp
+import numpy as np
+
+from lightgbm_tpu.ops import histogram as H
+from lightgbm_tpu.ops import pallas_hist as PH
+from lightgbm_tpu.utils.timer import time_op_in_jit
+
+
+def main():
+    n = int(sys.argv[1]) if len(sys.argv) > 1 else 10_000_000
+    f = int(sys.argv[2]) if len(sys.argv) > 2 else 28
+    b = int(sys.argv[3]) if len(sys.argv) > 3 else 64
+    L = 255
+    rng = np.random.RandomState(0)
+    bins = jnp.asarray(rng.randint(0, b, size=(n, f), dtype=np.uint8))
+    bins_T = jnp.asarray(np.asarray(bins).T.copy())
+    g = jnp.asarray(rng.randn(n).astype(np.float32) * 0.1)
+    h = jnp.abs(g) + 0.1
+    c = jnp.ones(n, jnp.float32)
+    gq = jnp.asarray(rng.randint(-127, 128, n, dtype=np.int8))
+    hq = jnp.asarray(rng.randint(0, 128, n, dtype=np.int8))
+    cq = jnp.ones(n, jnp.int8)
+    lid = jnp.asarray(rng.randint(0, L, n, dtype=np.int32))
+
+    print(f"# rows={n} f={f} b={b} backend={jax.default_backend()}")
+
+    for s in (2, 9, 33, 64, 65, 128, 129):
+        slot = jnp.asarray(rng.randint(0, 2 * s, n, dtype=np.int32))  # ~half masked
+        ms = time_op_in_jit(
+            lambda i, bt, gq_, hq_, cq_, sl: PH.hist_pallas_q8(
+                bt, (gq_.astype(jnp.int32) * 0 + i).astype(jnp.int8) + gq_,
+                hq_, cq_, sl, s, b, jnp.float32(1.0), jnp.float32(1.0)
+            )[0].sum(),
+            bins_T, gq, hq, cq, slot, K=4, reps=2)
+        print(f"hist_q8 S={s:4d} (lanes {s*3:4d} -> pad {-(-s*3//128)*128:4d}): "
+              f"{ms:7.2f} ms")
+
+    # route pass
+    tables = H.RouteTables(
+        feat=jnp.zeros(L, jnp.int32), thr=jnp.full(L, b // 2, jnp.int32),
+        dleft=jnp.zeros(L, jnp.int32), new_leaf=jnp.arange(L, dtype=jnp.int32),
+        slot_left=jnp.zeros(L, jnp.int32), slot_right=jnp.ones(L, jnp.int32))
+    ms = time_op_in_jit(
+        lambda i, bt, ll: PH.route_level_pallas(
+            bt, jnp.minimum(ll + i, L - 1), tables,
+            jnp.full(f, b + 1, jnp.int32), 128, L)[0].sum(),
+        bins_T, lid, K=4, reps=2)
+    print(f"route_level (S=128, L={L}): {ms:7.2f} ms")
+
+    # best_split over the whole frontier
+    from lightgbm_tpu.ops.split import SplitParams, best_split
+    sp = SplitParams()
+    hist_state = jnp.ones((L, 3, f, b), jnp.float32)
+    nb = jnp.full(f, b, jnp.int32)
+    nab = jnp.full(f, b + 1, jnp.int32)
+    ms = time_op_in_jit(
+        lambda i, hh: best_split(hh * i, nb, nab, jnp.ones(L),
+                                 jnp.ones(L) * 10, jnp.full(L, float(n)),
+                                 jnp.ones(f, bool), sp,
+                                 jnp.ones(L, bool)).gain.sum(),
+        hist_state, K=4, reps=2)
+    print(f"best_split frontier [L={L},3,{f},{b}]: {ms:7.2f} ms")
+
+    # leaf_sums + take_small (score update path)
+    ms = time_op_in_jit(
+        lambda i, g_, h_, c_, ll: PH.leaf_sums_pallas(
+            g_ * i, h_, c_, ll, L).sum(), g, h, c, lid, K=4, reps=2)
+    print(f"leaf_sums: {ms:7.2f} ms")
+    tab = jnp.ones(L, jnp.float32)
+    ms = time_op_in_jit(
+        lambda i, ll: PH.take_small_pallas(tab * i, ll).sum(),
+        lid, K=4, reps=2)
+    print(f"take_small: {ms:7.2f} ms")
+
+
+if __name__ == "__main__":
+    main()

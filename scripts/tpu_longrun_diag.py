@@ -1,0 +1,25 @@
+"""Diagnose TPU worker crash on long boosting runs (parity 500-iter)."""
+import sys, os, time
+sys.path.insert(0, "/root/repo")
+import numpy as np
+import jax
+import lightgbm_tpu as lgb
+from bench import synth_higgs
+
+n = int(sys.argv[1]) if len(sys.argv) > 1 else 1_000_000
+iters = int(sys.argv[2]) if len(sys.argv) > 2 else 500
+sync = int(sys.argv[3]) if len(sys.argv) > 3 else 10
+
+X, y = synth_higgs(n)
+params = {"objective": "binary", "num_leaves": 255, "max_bin": 63,
+          "learning_rate": 0.1, "min_data_in_leaf": 20, "verbosity": -1}
+ds = lgb.Dataset(X, label=y, params=params)
+ds.construct()
+b = lgb.Booster(params=params, train_set=ds)
+t0 = time.time()
+for i in range(iters):
+    b.update()
+    if (i + 1) % sync == 0:
+        jax.block_until_ready(b.raw_train_score())
+        print(f"iter {i+1} ok t={time.time()-t0:.1f}s", flush=True)
+print("DONE", time.time() - t0)

@@ -145,3 +145,20 @@ def test_every_registered_param_is_consumed():
     assert not missing, (
         f"registered but never consumed outside config.py: {missing} — "
         f"implement them or add to _EXPLICIT_NOT_CONSUMED with a reason")
+
+
+def test_feature_contri_noop_with_min_gain():
+    """A ~1.0 contri must not change trees even with min_gain_to_split > 0
+    (regression: the depthwise grower once re-applied the min-gain threshold
+    to the already-shifted penalized gains, shrinking trees)."""
+    X, y = _make_binary()
+    base = {"objective": "binary", "num_leaves": 16, "verbosity": -1,
+            "min_data_in_leaf": 5, "min_gain_to_split": 2.0,
+            "enable_bundle": False}
+    a = lgb.train(base, lgb.Dataset(X, label=y), num_boost_round=3)
+    b = lgb.train(dict(base, feature_contri=[0.9999] * 5),
+                  lgb.Dataset(X, label=y), num_boost_round=3)
+    ta, tb = a._ensure_host_trees(), b._ensure_host_trees()
+    assert [t.num_leaves for t in ta] == [t.num_leaves for t in tb]
+    np.testing.assert_allclose(a.predict(X[:100]), b.predict(X[:100]),
+                               rtol=1e-4)
