@@ -263,14 +263,7 @@ class Dataset:
         self.mappers = binned.mappers
         self.feature_map = binned.feature_map
         self.bundle_meta = None
-        if distributed and conf.enable_bundle and binned.bins.shape[1] >= 3:
-            # the greedy bundle plan depends on rank-LOCAL conflict counts —
-            # divergent plans across ranks would give different grower
-            # feature spaces and silently corrupt the histogram psum
-            log.warning("EFB bundling is disabled under distributed bin "
-                        "finding (rank-local conflict counts would produce "
-                        "divergent bundle plans)")
-        elif (conf.enable_bundle and binned.bins.shape[1] >= 3
+        if (conf.enable_bundle and binned.bins.shape[1] >= 3
                 and any(float(v) != 1.0 for v in (conf.feature_contri or []))):
             # a bundle column's split candidates span several member features;
             # one gain multiplier per column cannot represent per-member
@@ -287,10 +280,22 @@ class Dataset:
             excl = [u for u, orig in enumerate(fm)
                     if int(orig) < len(mc) and mc[int(orig)] != 0] \
                 if any(mc) else []
+            reduce_fn = None
+            if distributed:
+                # cross-rank count aggregation: every rank derives the
+                # IDENTICAL bundle plan from the globally-summed histograms
+                # and pairwise-conflict counts (plan_bundles docstring;
+                # divergent plans would corrupt the histogram psum)
+                from jax.experimental import multihost_utils
+
+                def reduce_fn(arr):
+                    return np.asarray(multihost_utils.process_allgather(
+                        jnp.asarray(arr))).sum(axis=0)
             meta = plan_bundles(binned.bins, self.mappers,
                                 max_conflict_rate=conf.max_conflict_rate,
                                 sparse_threshold=conf.sparse_threshold,
-                                seed=conf.data_random_seed, exclude=excl)
+                                seed=conf.data_random_seed, exclude=excl,
+                                reduce_fn=reduce_fn)
             if meta is not None:
                 self.bundle_meta = meta
                 self._bins_unbundled = binned.bins

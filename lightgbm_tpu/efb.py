@@ -66,8 +66,20 @@ def plan_bundles(bins: np.ndarray, mappers: List[BinMapper],
                  max_bundle_bins: int = 256,
                  sample_cnt: int = 50_000,
                  seed: int = 0,
-                 exclude=()) -> Optional[BundleMeta]:
+                 exclude=(),
+                 reduce_fn=None) -> Optional[BundleMeta]:
     """Greedy conflict-bounded bundling plan (FindGroups, dataset.cpp:92).
+
+    Every quantity the greedy consumes is a COUNT (per-feature bin
+    histograms + a pairwise co-nonzero matrix), so a distributed caller can
+    pass ``reduce_fn`` (sum across ranks) and every rank derives the IDENTICAL
+    plan from globally-aggregated counts — rank-local row shards never leak
+    into the plan. (The reference feeds FindGroups its local sample,
+    dataset.cpp:316; divergent plans would corrupt our histogram psum, so
+    determinism is a hard requirement here.) The greedy charges a bundle the
+    SUM of pairwise conflicts with its members — an upper bound on the true
+    union conflict (the exact row-set tracking of the reference), so bundles
+    are slightly conservative but reproducible from counts alone.
 
     Returns None when nothing bundles (dense data keeps its identity layout).
     """
@@ -75,52 +87,73 @@ def plan_bundles(bins: np.ndarray, mappers: List[BinMapper],
     rng = np.random.RandomState(seed)
     sample_idx = (np.arange(n) if n <= sample_cnt
                   else rng.choice(n, sample_cnt, replace=False))
-    max_conflicts = int(max_conflict_rate * len(sample_idx))
+    sub = bins[sample_idx]
+
+    # per-feature bin histograms over the (global when reduced) sample
+    maxb = max((m.num_bins for m in mappers), default=1)
+    counts = np.zeros((f, maxb), dtype=np.float64)
+    for j, m in enumerate(mappers):
+        bc = np.bincount(sub[:, j], minlength=maxb)
+        counts[j] = bc[:maxb]
+    if reduce_fn is not None:
+        counts = reduce_fn(counts)
+    total_sample = float(counts[0].sum()) if f else 0.0
+    max_conflicts = max_conflict_rate * total_sample
 
     default_bin = np.zeros(f, dtype=np.int32)
-    nnz = {}
     cand = []
     excluded = set(exclude)
     for j, m in enumerate(mappers):
         if m.bin_type == BIN_CATEGORICAL or m.missing_type != MISSING_NONE \
                 or m.num_bins < 2 or j in excluded:
             continue
-        cnt = np.bincount(bins[sample_idx, j], minlength=m.num_bins)
-        db = int(cnt.argmax())
-        if cnt[db] / max(len(sample_idx), 1) < sparse_threshold:
+        db = int(counts[j].argmax())
+        if counts[j, db] / max(total_sample, 1.0) < sparse_threshold:
             continue
         default_bin[j] = db
-        nnz[j] = np.nonzero(bins[sample_idx, j] != db)[0]
-        cand.append((j, len(nnz[j])))
+        cand.append((j, float(total_sample - counts[j, db])))
     if len(cand) < 2:
         return None
 
-    # greedy first-fit by nonzero count desc (dataset.cpp:120-180)
-    cand.sort(key=lambda t: -t[1])
+    # pairwise conflict counts C[i, j] = sample rows non-default in BOTH —
+    # an [Fc, Fc] contraction over the sample's nonzero mask, accumulated in
+    # row chunks so the dense mask never exceeds [8192, Fc] (a monolithic
+    # [50k, 4228] f32 mask would be a ~845MB transient at Allstate width)
+    cj = [j for j, _ in cand]
+    import jax.numpy as jnp
+    conf = np.zeros((len(cj), len(cj)), dtype=np.float64)
+    db_c = default_bin[cj][None, :]
+    for s0 in range(0, sub.shape[0], 8192):
+        nz = (sub[s0: s0 + 8192, cj] != db_c).astype(np.float32)
+        nz_dev = jnp.asarray(nz)
+        conf += np.asarray(nz_dev.T @ nz_dev, dtype=np.float64)
+    if reduce_fn is not None:
+        conf = reduce_fn(conf)
+    cidx = {j: k for k, j in enumerate(cj)}
+
+    # greedy first-fit by nonzero count desc (dataset.cpp:120-180);
+    # feature-id tie-break for full determinism
+    cand.sort(key=lambda t: (-t[1], t[0]))
     bundles: List[List[int]] = []
-    bundle_conflict: List[int] = []
+    bundle_conflict: List[float] = []
     bundle_bins: List[int] = []
-    bundle_rows: List[np.ndarray] = []
     for j, _cnt in cand:
         extra_bins = mappers[j].num_bins - 1
         placed = False
         for bi in range(len(bundles)):
             if bundle_bins[bi] + extra_bins > max_bundle_bins - 1:
                 continue
-            inter = np.intersect1d(bundle_rows[bi], nnz[j],
-                                   assume_unique=True).size
+            inter = sum(conf[cidx[i], cidx[j]] for i in bundles[bi])
             if bundle_conflict[bi] + inter <= max_conflicts:
                 bundles[bi].append(j)
                 bundle_conflict[bi] += inter
                 bundle_bins[bi] += extra_bins
-                bundle_rows[bi] = np.union1d(bundle_rows[bi], nnz[j])
                 placed = True
                 break
         if not placed:
             bundles.append([j])
-            bundle_conflict.append(0)
+            bundle_conflict.append(0.0)
             bundle_bins.append(extra_bins)
-            bundle_rows.append(nnz[j])
 
     multi = [sorted(b) for b in bundles if len(b) >= 2]
     if not multi:

@@ -102,18 +102,48 @@ class GBDT:
         # HistogramPool analog (feature_histogram.hpp:687): histogram_pool_size
         # MB -> cached-leaf-histogram budget; honored by the lossguide grower
         hist_pool = 0
+        lean_ft = 0
         if config.histogram_pool_size > 0:
-            per_leaf = 3 * train_set.num_features * B * 4
+            F_used = train_set.num_features
+            per_leaf = 3 * F_used * B * 4
             cap = int(config.histogram_pool_size * (1 << 20)
                       // max(1, per_leaf))
             if cap < config.num_leaves:
                 if config.grow_policy == "depthwise":
-                    log.warning(
-                        f"histogram_pool_size={config.histogram_pool_size}MB "
-                        f"caps {cap} leaf histograms < num_leaves="
-                        f"{config.num_leaves}; only grow_policy=lossguide "
-                        "honors the pool — the depthwise frontier state is "
-                        "whole-level by design")
+                    # lean depthwise mode (grow_tree_depthwise_lean): cached
+                    # split records + both-children measurement, histogram
+                    # pass feature-tiled so one [2*(L//2), 3, ft, B] tile
+                    # fits the budget
+                    incompat = []
+                    if config.tree_learner == "voting":
+                        incompat.append("voting-parallel")
+                    if config.tree_learner == "feature":
+                        # feature sharding already bounds per-shard width
+                        incompat.append("feature-parallel")
+                    if self._cegb_ok:
+                        incompat.append("CEGB")
+                    if config.forcedsplits_filename:
+                        incompat.append("forced splits")
+                    if config.feature_fraction_bynode < 1.0:
+                        incompat.append("feature_fraction_bynode")
+                    if str(config.packed_levels).lower() in ("true", "1"):
+                        incompat.append("packed_levels")
+                    if incompat:
+                        log.warning(
+                            "histogram_pool_size is ignored for the "
+                            f"depthwise grower with {', '.join(incompat)}; "
+                            "the whole-frontier state is kept")
+                    else:
+                        budget = int(config.histogram_pool_size * (1 << 20))
+                        slots = 2 * max(1, config.num_leaves // 2)
+                        lean_ft = max(1, min(
+                            F_used, budget // max(1, slots * 3 * B * 4)))
+                        log.info(
+                            f"histogram pool: lean depthwise mode, feature "
+                            f"tile {lean_ft}/{F_used} (budget "
+                            f"{config.histogram_pool_size}MB < "
+                            f"{per_leaf * config.num_leaves >> 20}MB "
+                            f"whole-frontier state)")
                 else:
                     hist_pool = max(2, cap)
                     log.info(f"histogram pool: {hist_pool} cached leaf "
@@ -148,6 +178,7 @@ class GBDT:
             ff_bynode=(config.feature_fraction_bynode
                        if config.grow_policy == "depthwise" else 1.0),
             hist_pool=hist_pool,
+            lean_ft=lean_ft,
             packed=str(config.packed_levels).lower() in ("true", "1"),
         )
         if (config.feature_fraction_bynode < 1.0
@@ -576,6 +607,9 @@ class GBDT:
                                         fmask, qs)
                 return tree, leaf_id[:n_orig], cegb_st
         elif self._fp:
+            # feature-parallel shards features, so the per-shard frontier is
+            # already width-bounded — lean mode is gated off in the pool
+            # setup (incompat list) and the default grower runs here
             from ..parallel.feature_parallel import fp_grow_params
             from ..ops.grow_depthwise import grow_tree_depthwise as _gtd
             gp_fp = fp_grow_params(gp)
@@ -678,6 +712,9 @@ class GBDT:
 
     def _grow_fn(self):
         if self.config.grow_policy == "depthwise":
+            if self.gp.lean_ft > 0:
+                from ..ops.grow_depthwise import grow_tree_depthwise_lean
+                return grow_tree_depthwise_lean
             from ..ops.grow_depthwise import grow_tree_depthwise
             return grow_tree_depthwise
         return grow_tree
@@ -798,8 +835,7 @@ class GBDT:
                 gw, hw, cw = (shard_rows(x, self._mesh) for x in (gw, hw, cw))
                 grow_fn = grow_tree
                 if depthwise:
-                    from ..ops.grow_depthwise import grow_tree_depthwise
-                    grow_fn = grow_tree_depthwise
+                    grow_fn = self._grow_fn()   # honors lean_ft (pool budget)
                 tree_dev, leaf_id = grow_tree_dp(
                     self._bins_dp, gw, hw, cw, ts.num_bins_dev, ts.na_bin_dev,
                     fmask, self.gp, self._mesh, grow_fn=grow_fn,
@@ -807,7 +843,7 @@ class GBDT:
                     qseed=jnp.int32(self.iter_ * k + cls))
                 leaf_id = leaf_id[: self._n_orig]
             elif depthwise:
-                from ..ops.grow_depthwise import grow_tree_depthwise
+                grow_tree_depthwise = self._grow_fn()  # honors lean_ft
                 qkw = ({"qseed": jnp.int32(self.iter_ * k + cls)}
                        if (self.gp.quant or self.gp.ff_bynode < 1.0) else {})
                 if self._cegb_dev is not None:

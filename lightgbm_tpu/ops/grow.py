@@ -59,6 +59,12 @@ class GrowParams:
     # level cost stops scaling with frontier width. Serial + quantized +
     # pallas path only (the grower falls back silently otherwise)
     packed: bool = False
+    # lean depthwise mode (histogram_pool_size for the DEPTHWISE grower,
+    # VERDICT r3 weak #6): feature-tile width for the pass/search so live
+    # histogram memory stays within the pool budget — the [L, 3, F, B]
+    # frontier state is replaced by cached per-leaf split records and
+    # both-children measurement. 0 = off (whole-frontier state)
+    lean_ft: int = 0
     # Data-parallel axis (reference: DataParallelTreeLearner,
     # data_parallel_tree_learner.cpp:149-240). When set, rows are sharded over this
     # mesh axis under shard_map and every histogram / root-sum is psum-ed — the

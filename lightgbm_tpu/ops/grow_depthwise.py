@@ -34,8 +34,8 @@ import jax.numpy as jnp
 
 from . import histogram as H
 from .grow import GrowParams, TreeArrays, _empty_tree, _psum
-from .split import (NEG_INF, SplitParams, best_split, leaf_output,
-                    per_feature_gains)
+from .split import (NEG_INF, SplitParams, SplitResult, best_split,
+                    leaf_output, per_feature_gains)
 
 _OOB = 1 << 20  # out-of-bounds scatter index (dropped with mode="drop")
 
@@ -96,6 +96,70 @@ def _scatter_set(arr, idx, val, mask):
     """arr[idx] = val where mask (vectorized, dropped where ~mask)."""
     safe = jnp.where(mask, idx, _OOB)
     return arr.at[safe].set(val, mode="drop")
+
+
+def _apply_level_to_tree(tr: TreeArrays, parent_node, parent_right, res,
+                         sel, node_id, new_leaf, leaves_iota,
+                         lg, lh, lc, rg, rh, rc, w_l, w_r, w_p,
+                         num_sel) -> TreeArrays:
+    """Masked-scatter application of one level's selected splits to the tree
+    arrays (shared by the default and lean depthwise growers)."""
+    feat, thr, dleft = res.feature, res.bin, res.default_left
+    has_par = sel & (parent_node >= 0)
+    lc_arr = _scatter_set(tr.left_child, parent_node,
+                          node_id, has_par & ~parent_right)
+    rc_arr = _scatter_set(tr.right_child, parent_node,
+                          node_id, has_par & parent_right)
+    return TreeArrays(
+        split_feature=_scatter_set(tr.split_feature, node_id, feat, sel),
+        threshold_bin=_scatter_set(tr.threshold_bin, node_id, thr, sel),
+        default_left=_scatter_set(tr.default_left, node_id, dleft, sel),
+        left_child=_scatter_set(lc_arr, node_id, ~leaves_iota, sel),
+        right_child=_scatter_set(rc_arr, node_id, ~new_leaf, sel),
+        split_gain=_scatter_set(tr.split_gain, node_id,
+                                res.gain.astype(jnp.float32), sel),
+        leaf_value=_scatter_set(
+            _scatter_set(tr.leaf_value, leaves_iota, w_l, sel),
+            new_leaf, w_r, sel),
+        leaf_weight=_scatter_set(
+            _scatter_set(tr.leaf_weight, leaves_iota, lh, sel),
+            new_leaf, rh, sel),
+        leaf_count=_scatter_set(
+            _scatter_set(tr.leaf_count, leaves_iota, lc, sel),
+            new_leaf, rc, sel),
+        internal_value=_scatter_set(tr.internal_value, node_id, w_p, sel),
+        internal_weight=_scatter_set(tr.internal_weight, node_id,
+                                     lh + rh, sel),
+        internal_count=_scatter_set(tr.internal_count, node_id,
+                                    lc + rc, sel),
+        num_leaves=tr.num_leaves + num_sel,
+        is_cat=_scatter_set(tr.is_cat, node_id, res.is_cat, sel),
+        cat_mask=_scatter_set(tr.cat_mask, node_id, res.cat_member, sel),
+    )
+
+
+def _monotone_child_bounds(sp: SplitParams, f: int, res, feat, sel,
+                           w_l, w_r, leaf_min, leaf_max, leaves_iota,
+                           new_leaf):
+    """Monotone output-bound propagation to the two children of each selected
+    split (LeafConstraints::UpdateConstraints, monotone_constraints.hpp:44);
+    shared by the default and lean depthwise growers."""
+    mono_tab = jnp.zeros(f, jnp.int32)
+    mc = jnp.asarray(sp.monotone_constraints[:f], jnp.int32)
+    mono_tab = mono_tab.at[jnp.arange(mc.shape[0])].set(mc)
+    mf = jnp.where(res.is_cat, 0, mono_tab[feat])   # cat splits: none
+    mid = (w_l + w_r) / 2.0
+    lmin_l = jnp.where(sel & (mf < 0), jnp.maximum(leaf_min, mid), leaf_min)
+    lmax_l = jnp.where(sel & (mf > 0), jnp.minimum(leaf_max, mid), leaf_max)
+    lmin_r = jnp.where(sel & (mf > 0), jnp.maximum(leaf_min, mid), leaf_min)
+    lmax_r = jnp.where(sel & (mf < 0), jnp.minimum(leaf_max, mid), leaf_max)
+    leaf_min2 = _scatter_set(
+        _scatter_set(leaf_min, leaves_iota, lmin_l, sel),
+        new_leaf, lmin_r, sel)
+    leaf_max2 = _scatter_set(
+        _scatter_set(leaf_max, leaves_iota, lmax_l, sel),
+        new_leaf, lmax_r, sel)
+    return leaf_min2, leaf_max2
 
 
 @partial(jax.jit, static_argnames=("gp",))
@@ -395,49 +459,20 @@ def grow_tree_depthwise(bins: jnp.ndarray, g: jnp.ndarray, h: jnp.ndarray,
         lg, lh, lc = res.left_g, res.left_h, res.left_cnt
         rg, rh, rc = st.leaf_g - lg, st.leaf_h - lh, st.leaf_c - lc
 
-        # ---- tree arrays (masked scatters over node/leaf ids) ----
-        tr = st.tree
+        # ---- tree arrays (masked scatters over node/leaf ids); outputs
+        # clamped by monotone bounds (CalculateSplittedLeafOutput with
+        # ConstraintEntry, feature_histogram.hpp:498) ----
         w_l = leaf_output(lg, lh, sp)
         w_r = leaf_output(rg, rh, sp)
         w_p = leaf_output(st.leaf_g, st.leaf_h, sp)
         if sp.has_monotone:
-            # clamp outputs by the leaf's bounds (CalculateSplittedLeafOutput
-            # with ConstraintEntry, feature_histogram.hpp:498)
             w_l = jnp.clip(w_l, st.leaf_min, st.leaf_max)
             w_r = jnp.clip(w_r, st.leaf_min, st.leaf_max)
             w_p = jnp.clip(w_p, st.leaf_min, st.leaf_max)
-        # parent child-pointer fixup
-        has_par = sel & (st.parent_node >= 0)
-        lc_arr = _scatter_set(tr.left_child, st.parent_node,
-                              node_id, has_par & ~st.parent_right)
-        rc_arr = _scatter_set(tr.right_child, st.parent_node,
-                              node_id, has_par & st.parent_right)
-        tr = TreeArrays(
-            split_feature=_scatter_set(tr.split_feature, node_id, feat, sel),
-            threshold_bin=_scatter_set(tr.threshold_bin, node_id, thr, sel),
-            default_left=_scatter_set(tr.default_left, node_id, dleft, sel),
-            left_child=_scatter_set(lc_arr, node_id, ~leaves_iota, sel),
-            right_child=_scatter_set(rc_arr, node_id, ~new_leaf, sel),
-            split_gain=_scatter_set(tr.split_gain, node_id,
-                                    res.gain.astype(jnp.float32), sel),
-            leaf_value=_scatter_set(
-                _scatter_set(tr.leaf_value, leaves_iota, w_l, sel),
-                new_leaf, w_r, sel),
-            leaf_weight=_scatter_set(
-                _scatter_set(tr.leaf_weight, leaves_iota, lh, sel),
-                new_leaf, rh, sel),
-            leaf_count=_scatter_set(
-                _scatter_set(tr.leaf_count, leaves_iota, lc, sel),
-                new_leaf, rc, sel),
-            internal_value=_scatter_set(tr.internal_value, node_id, w_p, sel),
-            internal_weight=_scatter_set(tr.internal_weight, node_id,
-                                         st.leaf_h, sel),
-            internal_count=_scatter_set(tr.internal_count, node_id,
-                                        st.leaf_c, sel),
-            num_leaves=tr.num_leaves + num_sel,
-            is_cat=_scatter_set(tr.is_cat, node_id, res.is_cat, sel),
-            cat_mask=_scatter_set(tr.cat_mask, node_id, res.cat_member, sel),
-        )
+        tr = _apply_level_to_tree(st.tree, st.parent_node, st.parent_right,
+                                  res, sel, node_id, new_leaf, leaves_iota,
+                                  lg, lh, lc, rg, rh, rc, w_l, w_r, w_p,
+                                  num_sel)
 
         # ---- CEGB bookkeeping (UpdateLeafBestSplits, cegb hpp:63-86):
         # selected splits mark their feature model-used (coupled) and mark
@@ -566,25 +601,9 @@ def grow_tree_depthwise(bins: jnp.ndarray, g: jnp.ndarray, h: jnp.ndarray,
         # monotone_constraints.hpp:44-58): children inherit the parent entry;
         # a split on a monotone feature pins the midpoint between them ----
         if sp.has_monotone:
-            mono_tab = jnp.zeros(f, jnp.int32)
-            mc = jnp.asarray(sp.monotone_constraints[:f], jnp.int32)
-            mono_tab = mono_tab.at[jnp.arange(mc.shape[0])].set(mc)
-            mf = jnp.where(res.is_cat, 0, mono_tab[feat])   # cat splits: none
-            mid = (w_l + w_r) / 2.0
-            lmin_l = jnp.where(sel & (mf < 0), jnp.maximum(st.leaf_min, mid),
-                               st.leaf_min)
-            lmax_l = jnp.where(sel & (mf > 0), jnp.minimum(st.leaf_max, mid),
-                               st.leaf_max)
-            lmin_r = jnp.where(sel & (mf > 0), jnp.maximum(st.leaf_min, mid),
-                               st.leaf_min)
-            lmax_r = jnp.where(sel & (mf < 0), jnp.minimum(st.leaf_max, mid),
-                               st.leaf_max)
-            leaf_min2 = _scatter_set(
-                _scatter_set(st.leaf_min, leaves_iota, lmin_l, sel),
-                new_leaf, lmin_r, sel)
-            leaf_max2 = _scatter_set(
-                _scatter_set(st.leaf_max, leaves_iota, lmax_l, sel),
-                new_leaf, lmax_r, sel)
+            leaf_min2, leaf_max2 = _monotone_child_bounds(
+                sp, f, res, feat, sel, w_l, w_r, st.leaf_min, st.leaf_max,
+                leaves_iota, new_leaf)
         else:
             leaf_min2, leaf_max2 = st.leaf_min, st.leaf_max
 
@@ -687,4 +706,333 @@ def grow_tree_depthwise(bins: jnp.ndarray, g: jnp.ndarray, h: jnp.ndarray,
             leaf_count=jnp.where(live, ec, tr.leaf_count)))
     if cegb_on:
         return state.tree, state.leaf_id, state.cegb
+    return state.tree, state.leaf_id
+
+
+# ---------------------------------------------------------------------------
+# lean depthwise grower: histogram_pool_size for the level-wise path
+# ---------------------------------------------------------------------------
+
+class _LeanState(NamedTuple):
+    leaf_id: jnp.ndarray      # [N]
+    rec: "object"             # SplitResult of [L]-shaped cached candidates
+    leaf_g: jnp.ndarray       # [L]
+    leaf_h: jnp.ndarray
+    leaf_c: jnp.ndarray
+    active: jnp.ndarray       # [L] bool
+    parent_node: jnp.ndarray
+    parent_right: jnp.ndarray
+    leaf_min: jnp.ndarray
+    leaf_max: jnp.ndarray
+    tree: TreeArrays
+
+
+def _tile_split_params(sp: SplitParams, lo: int, hi: int) -> SplitParams:
+    """Re-index per-feature STATIC config to a [lo, hi) feature tile."""
+    import dataclasses
+    kw = {}
+    if sp.cat_features:
+        kw["cat_features"] = tuple(c - lo for c in sp.cat_features
+                                   if lo <= c < hi)
+    if sp.monotone_constraints:
+        mc = list(sp.monotone_constraints)
+        kw["monotone_constraints"] = tuple((mc + [0] * hi)[lo:hi])
+    if sp.feature_contri:
+        fc = list(sp.feature_contri)
+        kw["feature_contri"] = tuple((fc + [1.0] * hi)[lo:hi])
+    return dataclasses.replace(sp, **kw) if kw else sp
+
+
+def _fold_best(a, b):
+    """Keep the higher-gain candidate per leaf (earlier tile wins ties —
+    matching the monolithic argmax's first-max preference in feature order)."""
+    take = b.gain > a.gain
+    out = []
+    for va, vb in zip(a, b):
+        t = take.reshape(take.shape + (1,) * (va.ndim - take.ndim))
+        out.append(jnp.where(t, vb, va))
+    return SplitResult(*out)
+
+
+def _slice_bundle(bundle, lo, hi):
+    if bundle is None:
+        return None
+    return type(bundle)(*[v[lo:hi] for v in bundle])
+
+
+@partial(jax.jit, static_argnames=("gp",))
+def grow_tree_depthwise_lean(bins: jnp.ndarray, g, h, c, num_bins, na_bin,
+                             feature_mask, gp: GrowParams, bundle=None,
+                             forced=None, qseed=None, cegb=None):
+    """Depthwise growth under a histogram-memory budget (reference analog:
+    HistogramPool, feature_histogram.hpp:687 + serial_tree_learner.cpp:39-52
+    sizing — here the budget bounds LIVE histogram tiles instead of caching
+    per-leaf histograms).
+
+    Design: the default grower keeps [L, 3, F, B] per-leaf histograms for
+    sibling subtraction and deferred-leaf search — ~830 MB at Allstate width
+    (F=4228, L=255, B=64). This mode keeps NO per-leaf histograms:
+
+    - each active leaf caches its best SPLIT RECORD (a SplitResult row —
+      gain/feature/bin/left stats/cat mask), valid until the leaf splits
+      because its row set never changes while deferred;
+    - each level measures BOTH children of every selected split (2S slots;
+      no parent histogram needed for subtraction);
+    - the histogram pass + best-split search run per FEATURE TILE of width
+      ``gp.lean_ft`` (a Python-unrolled loop inside the jit), folding the
+      per-tile winners — live histogram memory is [2S, 3, ft, B] for one
+      tile, chosen by GBDT to fit histogram_pool_size.
+
+    Not combined with voting/CEGB/forced-splits/ff_bynode/packed (GBDT keeps
+    the default grower and warns). Ties across missing-direction planes of
+    different tiles may break differently from the monolithic search (both
+    prefer the lower feature id within a plane).
+    """
+    n, f = bins.shape
+    L, B = gp.num_leaves, gp.max_bin
+    sp = gp.split
+    ft = max(1, min(gp.lean_ft or f, f))
+    n_tiles = -(-f // ft)
+    max_levels = gp.max_depth if gp.max_depth > 0 else max(1, L - 1)
+    MAX_SLOTS = max(1, L // 2)
+
+    use_pallas = H.pick_impl(gp.hist_impl) == "pallas"
+    bins_T = bins.T if use_pallas else None
+    # quantization mirrors hist_routed exactly (histogram.py:433-436): the
+    # q8 kernel on the pallas path, per-row dequantized channels elsewhere —
+    # so lean and default growers see the SAME histogram numbers per impl
+    quant = H.make_quant(g, h, c, qseed) if gp.quant else None
+    if quant is not None and not use_pallas:
+        gm = quant.gq.astype(jnp.float32) * (quant.scale_g / 127.0)
+        hm = quant.hq.astype(jnp.float32) * (quant.scale_h / 127.0)
+        cm = quant.cq.astype(jnp.float32)
+    else:
+        gm, hm, cm = g, h, c
+    interp = jax.default_backend() == "cpu"
+
+    def measure_tile(slot, n_slots, lo, hi):
+        """[n_slots, 3, hi-lo, B] histograms of one feature tile, psum'd."""
+        if quant is not None and use_pallas:
+            from .pallas_hist import hist_pallas_q8
+            ht = hist_pallas_q8(bins_T[lo:hi], quant.gq, quant.hq, quant.cq,
+                                slot, n_slots, B, quant.scale_g,
+                                quant.scale_h, interpret=interp)
+        else:
+            ht = H.hist_per_leaf(bins[:, lo:hi], gm, hm, cm, slot, n_slots, B,
+                                 gp.hist_impl,
+                                 bins_T=bins_T[lo:hi] if bins_T is not None
+                                 else None)
+        return _psum(ht, gp)
+
+    def tiled_search(slot, n_slots, sg, sh, sc, allow, lmin, lmax):
+        """Best split per slot from feature-tiled passes."""
+        best = None
+        for t in range(n_tiles):
+            lo, hi = t * ft, min(f, (t + 1) * ft)
+            hist_t = measure_tile(slot, n_slots, lo, hi)
+            res_t = best_split(hist_t, num_bins[lo:hi], na_bin[lo:hi],
+                               sg, sh, sc, feature_mask[lo:hi],
+                               _tile_split_params(sp, lo, hi), allow,
+                               leaf_min=lmin, leaf_max=lmax,
+                               bundle=_slice_bundle(bundle, lo, hi))
+            res_t = res_t._replace(
+                feature=res_t.feature + jnp.int32(lo))
+            best = res_t if best is None else _fold_best(best, res_t)
+        return best
+
+    # ---- root ----
+    zeros_slot = jnp.zeros(n, jnp.int32)
+    # root stats from one tiny exact pass (leaf renewal needs them anyway)
+    from .pallas_hist import leaf_sums_pallas
+    if use_pallas:
+        sums0 = _psum(leaf_sums_pallas(g, h, c, zeros_slot, 1,
+                                       interpret=interp), gp)
+        g0, h0, c0 = sums0[0, 0], sums0[1, 0], sums0[2, 0]
+    else:
+        g0, h0, c0 = (_psum(g.sum(), gp), _psum(h.sum(), gp),
+                      _psum(c.sum(), gp))
+    rec0 = tiled_search(zeros_slot, 1, g0[None], h0[None], c0[None],
+                        jnp.ones(1, bool), jnp.full(1, -jnp.inf),
+                        jnp.full(1, jnp.inf))
+
+    def pad_rec(r1):
+        """[1]-shaped root record -> [L] record arrays."""
+        out = []
+        for v in r1:
+            shape = (L,) + v.shape[1:]
+            base = jnp.full(shape, NEG_INF, v.dtype) \
+                if v.dtype in (jnp.float32, jnp.float64) \
+                else jnp.zeros(shape, v.dtype)
+            out.append(base.at[0].set(v[0]))
+        return SplitResult(*out)
+
+    state = _LeanState(
+        leaf_id=jnp.zeros(n, dtype=jnp.int32),
+        rec=pad_rec(rec0),
+        leaf_g=jnp.zeros(L).at[0].set(g0),
+        leaf_h=jnp.zeros(L).at[0].set(h0),
+        leaf_c=jnp.zeros(L).at[0].set(c0),
+        active=jnp.zeros(L, bool).at[0].set(True),
+        parent_node=jnp.full(L, -1, jnp.int32),
+        parent_right=jnp.zeros(L, bool),
+        leaf_min=jnp.full(L, -jnp.inf),
+        leaf_max=jnp.full(L, jnp.inf),
+        tree=_empty_tree(L, B),
+    )
+    root_w = leaf_output(g0, h0, sp)
+    state = state._replace(tree=state.tree._replace(
+        leaf_value=state.tree.leaf_value.at[0].set(root_w),
+        leaf_weight=state.tree.leaf_weight.at[0].set(h0),
+        leaf_count=state.tree.leaf_count.at[0].set(c0)))
+    leaves_iota = jnp.arange(L, dtype=jnp.int32)
+
+    def level(st: _LeanState, SLOTS: int, lvl):
+        res = st.rec
+        gain_gate = 0.0 if sp.has_contri \
+            else float(max(sp.min_gain_to_split, 0.0))
+        cand = st.active & (res.gain > gain_gate) & (res.gain > NEG_INF / 2)
+        budget = L - st.tree.num_leaves
+        key = jnp.where(cand, res.gain, -jnp.inf)
+        kj, ki = key[None, :], key[:, None]
+        better = (kj > ki) | ((kj == ki)
+                              & (leaves_iota[None, :] < leaves_iota[:, None]))
+        rank = jnp.sum(better, axis=1).astype(jnp.int32)
+        sel = cand & (rank < jnp.minimum(budget, SLOTS))
+        num_sel = sel.sum().astype(jnp.int32)
+
+        idx_in_lvl = (jnp.cumsum(sel.astype(jnp.int32)) - 1).astype(jnp.int32)
+        node_id = st.tree.num_leaves - 1 + idx_in_lvl
+        new_leaf = st.tree.num_leaves + idx_in_lvl
+
+        feat, thr, dleft = res.feature, res.bin, res.default_left
+        lg, lh, lc = res.left_g, res.left_h, res.left_cnt
+        rg, rh, rc = st.leaf_g - lg, st.leaf_h - lh, st.leaf_c - lc
+
+        # ---- tree arrays (shared scatter helper) ----
+        w_l = leaf_output(lg, lh, sp)
+        w_r = leaf_output(rg, rh, sp)
+        w_p = leaf_output(st.leaf_g, st.leaf_h, sp)
+        if sp.has_monotone:
+            w_l = jnp.clip(w_l, st.leaf_min, st.leaf_max)
+            w_r = jnp.clip(w_r, st.leaf_min, st.leaf_max)
+            w_p = jnp.clip(w_p, st.leaf_min, st.leaf_max)
+        tr = _apply_level_to_tree(st.tree, st.parent_node, st.parent_right,
+                                  res, sel, node_id, new_leaf, leaves_iota,
+                                  lg, lh, lc, rg, rh, rc, w_l, w_r, w_p,
+                                  num_sel)
+
+        # ---- route: BOTH children measured (slots 2i / 2i+1) ----
+        S_pass = 2 * SLOTS
+        tables = H.RouteTables(
+            feat=jnp.where(sel, feat, -1),
+            thr=thr,
+            dleft=dleft.astype(jnp.int32),
+            new_leaf=new_leaf,
+            slot_left=jnp.where(sel, idx_in_lvl * 2, S_pass),
+            slot_right=jnp.where(sel, idx_in_lvl * 2 + 1, S_pass),
+            is_cat=(res.is_cat & sel).astype(jnp.int32)
+            if (sp.cat_features or sp.has_bundles) else None,
+            member=(res.cat_member & sel[:, None]).astype(jnp.float32)
+            if (sp.cat_features or sp.has_bundles) else None,
+        )
+        if use_pallas and f <= 512:
+            from .pallas_hist import route_level_pallas
+            slot, leaf_id2 = route_level_pallas(bins_T, st.leaf_id, tables,
+                                                na_bin, S_pass, L,
+                                                interpret=interp)
+        else:
+            slot, leaf_id2 = H.route_level(bins, st.leaf_id, tables, na_bin,
+                                           S_pass)
+
+        # ---- monotone bound propagation (shared helper) ----
+        if sp.has_monotone:
+            leaf_min2, leaf_max2 = _monotone_child_bounds(
+                sp, f, res, feat, sel, w_l, w_r, st.leaf_min, st.leaf_max,
+                leaves_iota, new_leaf)
+        else:
+            leaf_min2, leaf_max2 = st.leaf_min, st.leaf_max
+
+        # ---- per-leaf stats / frontier update ----
+        leaf_g2 = _scatter_set(_scatter_set(st.leaf_g, leaves_iota, lg, sel),
+                               new_leaf, rg, sel)
+        leaf_h2 = _scatter_set(_scatter_set(st.leaf_h, leaves_iota, lh, sel),
+                               new_leaf, rh, sel)
+        leaf_c2 = _scatter_set(_scatter_set(st.leaf_c, leaves_iota, lc, sel),
+                               new_leaf, rc, sel)
+        active2 = _scatter_set(sel, new_leaf, jnp.ones(L, bool), sel)
+        pn2 = _scatter_set(
+            _scatter_set(st.parent_node, leaves_iota, node_id, sel),
+            new_leaf, node_id, sel)
+        pr2 = _scatter_set(
+            _scatter_set(st.parent_right, leaves_iota,
+                         jnp.zeros(L, bool), sel),
+            new_leaf, jnp.ones(L, bool), sel)
+
+        # ---- fresh records for the 2S children (feature-tiled search) ----
+        # per-slot stats: slot 2i = left child of split i, 2i+1 = right
+        leaf_of_slot_l = _scatter_set(jnp.full(SLOTS, _OOB, jnp.int32),
+                                      idx_in_lvl, leaves_iota, sel)
+        slot_leaf = jnp.stack(
+            [leaf_of_slot_l,
+             _scatter_set(jnp.full(SLOTS, _OOB, jnp.int32), idx_in_lvl,
+                          new_leaf, sel)], axis=1).reshape(S_pass)
+        slot_ok = slot_leaf < L
+        safe_leaf = jnp.minimum(slot_leaf, L - 1)
+        sgv = leaf_g2[safe_leaf]
+        shv = leaf_h2[safe_leaf]
+        scv = leaf_c2[safe_leaf]
+        lminv = leaf_min2[safe_leaf]
+        lmaxv = leaf_max2[safe_leaf]
+        child_rec = tiled_search(slot, S_pass, sgv, shv, scv, slot_ok,
+                                 lminv, lmaxv)
+
+        rec2 = SplitResult(*[
+            _scatter_set(rv, jnp.where(slot_ok, slot_leaf, _OOB), cv, slot_ok)
+            for rv, cv in zip(st.rec, child_rec)])
+
+        return _LeanState(
+            leaf_id=leaf_id2, rec=rec2,
+            leaf_g=leaf_g2, leaf_h=leaf_h2, leaf_c=leaf_c2,
+            active=active2, parent_node=pn2, parent_right=pr2,
+            leaf_min=leaf_min2, leaf_max=leaf_max2,
+            tree=tr,
+        ), num_sel
+
+    n_unroll = min(max_levels,
+                   max(1, math.ceil(math.log2(max(L - 1, 2)))) + 1)
+    last_sel = jnp.int32(1)
+    for k in range(n_unroll):
+        slots_k = min(2 ** k, MAX_SLOTS)
+        state, last_sel = jax.lax.cond(
+            (last_sel > 0) & (state.tree.num_leaves < L),
+            lambda st, _s=slots_k, _k=k: level(st, _s, jnp.int32(_k)),
+            lambda st: (st, jnp.int32(0)),
+            state)
+    if max_levels > n_unroll:
+        def cond(carry):
+            st, lvl, last = carry
+            return (lvl < max_levels) & (last > 0) & (st.tree.num_leaves < L)
+
+        def body(carry):
+            st, lvl, _ = carry
+            st2, num_sel = level(st, MAX_SLOTS, lvl)
+            return st2, lvl + 1, num_sel
+
+        state, _, _ = jax.lax.while_loop(
+            cond, body, (state, jnp.int32(n_unroll), last_sel))
+
+    if gp.quant:
+        # leaf renewal from EXACT sums (same epilogue as the default grower)
+        sums = _psum(leaf_sums_pallas(g, h, c, state.leaf_id, L,
+                                      interpret=interp), gp)
+        eg, eh, ec = sums[0], sums[1], sums[2]
+        w = leaf_output(eg, eh, sp)
+        if sp.has_monotone:
+            w = jnp.clip(w, state.leaf_min, state.leaf_max)
+        tr = state.tree
+        live = jnp.arange(L) < tr.num_leaves
+        state = state._replace(tree=tr._replace(
+            leaf_value=jnp.where(live, w, tr.leaf_value),
+            leaf_weight=jnp.where(live, eh, tr.leaf_weight),
+            leaf_count=jnp.where(live, ec, tr.leaf_count)))
     return state.tree, state.leaf_id

@@ -62,6 +62,35 @@ def main():
     assert digests.shape[0] >= 2 and np.all(digests == digests[0]), \
         f"mappers diverge: {digests}"
 
+    # ---- distributed EFB: identical bundle plans from GLOBAL counts ----
+    # 3 groups of 3 mutually-exclusive sparse features; each rank holds a
+    # different row shard, so rank-local conflict counts WOULD diverge —
+    # the reduce_fn path must still produce identical BundleMeta
+    rngE = np.random.RandomState(7)
+    nE, gE = 4000, 3
+    XE_full = np.zeros((nE, 3 * gE))
+    for gset in range(gE):
+        pick = rngE.randint(0, 3, nE)
+        XE_full[np.arange(nE), gset * 3 + pick] = rngE.rand(nE) + 0.5
+    XE = XE_full[round_robin_rows(nE, rank, 2)]
+    mappersE = find_bin_mappers_distributed(XE, max_bin=16, sample_cnt=50000)
+    binnedE = bin_data(XE, mappersE)
+    from lightgbm_tpu.efb import plan_bundles
+
+    def _reduce(arr):
+        return np.asarray(multihost_utils.process_allgather(
+            jnp.asarray(arr))).sum(axis=0)
+
+    meta = plan_bundles(binnedE.bins, binnedE.mappers,
+                        max_conflict_rate=0.0, sparse_threshold=0.5,
+                        reduce_fn=_reduce)
+    assert meta is not None, "exclusive sparse features should bundle"
+    md = _digest([meta.num_bins, meta.range_start, meta.range_end,
+                  np.asarray([len(m) for m in meta.members]),
+                  np.asarray([j for m in meta.members for j, _, _ in m])])
+    mds = np.asarray(multihost_utils.process_allgather(md))
+    assert np.all(mds == mds[0]), f"bundle plans diverge across ranks: {mds}"
+
     # ---- one data-parallel training step over the global 2-process mesh ----
     binned = bin_data(Xl, mappers)
     n_all = np.asarray(multihost_utils.process_allgather(

@@ -195,3 +195,30 @@ def test_dp_equals_serial_training_1m():
     sub = X[:: 100]
     np.testing.assert_allclose(b1.predict(sub), b2.predict(sub),
                                rtol=1e-3, atol=1e-4)
+
+
+def test_dp_with_efb_equals_serial_with_efb():
+    """DP training on EFB-bundled columns == serial training on the same
+    bundles (VERDICT r3 next #7 'DP-with-EFB == serial-with-EFB trees')."""
+    rng = np.random.RandomState(5)
+    n = 3000
+    X = np.zeros((n, 9))
+    for g in range(3):
+        # asymmetric occupancy so split gains don't tie (psum summation
+        # order would break exact ties differently from serial)
+        pick = rng.choice(3, n, p=[0.6, 0.3, 0.1])
+        X[np.arange(n), g * 3 + pick] = rng.rand(n) * (g + 1) + 0.5
+    w = np.array([1.0, -0.7, 0.4, 0.9, -0.3, 0.2, 0.6, -0.8, 0.1])
+    y = (X @ w + 0.1 * rng.randn(n) > 0.5).astype(np.float64)
+    p = {"objective": "binary", "num_leaves": 15, "verbosity": -1,
+         "min_data_in_leaf": 5, "sparse_threshold": 0.5}
+    b1 = lgb.train(p, lgb.Dataset(X, label=y), num_boost_round=8)
+    assert b1.train_set.bundle_meta is not None, "expected EFB bundles"
+    b2 = lgb.train({**p, "tree_learner": "data"}, lgb.Dataset(X, label=y),
+                   num_boost_round=8)
+    assert b2.train_set.bundle_meta is not None
+    np.testing.assert_allclose(b1.predict(X), b2.predict(X),
+                               rtol=1e-3, atol=1e-4)
+    t1, t2 = b1._ensure_host_trees(), b2._ensure_host_trees()
+    for a, b in zip(t1, t2):
+        assert a.num_leaves == b.num_leaves
