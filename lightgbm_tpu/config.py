@@ -131,6 +131,8 @@ _PARAMS: Dict[str, Tuple[Any, Tuple[str, ...]]] = {
     "lambdarank_truncation_level": (20, ("max_position",)),
     "lambdarank_norm": (True, ()),
     "label_gain": ([], ()),
+    # auc_mu class-weight matrix, flat num_class^2 list (config.h:850)
+    "auc_mu_weights": ([], ()),
     # ---- metric ----
     "metric": ([], ("metrics", "metric_types")),
     "metric_freq": (1, ("output_freq",)),
@@ -169,7 +171,7 @@ _PARAMS: Dict[str, Tuple[Any, Tuple[str, ...]]] = {
     "mesh_axis": ("data", ()),             # mesh axis name for data-parallel sharding
 }
 
-_LIST_FLOAT = {"feature_contri", "cegb_penalty_feature_lazy", "cegb_penalty_feature_coupled", "label_gain"}
+_LIST_FLOAT = {"feature_contri", "cegb_penalty_feature_lazy", "cegb_penalty_feature_coupled", "label_gain", "auc_mu_weights"}
 _LIST_INT = {"monotone_constraints", "eval_at", "max_bin_by_feature"}
 _LIST_STR = {"valid", "metric", "valid_data_initscores"}
 _MAYBE_INT = {"seed"}

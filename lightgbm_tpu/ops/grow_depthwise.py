@@ -728,7 +728,14 @@ class _LeanState(NamedTuple):
 
 
 def _tile_split_params(sp: SplitParams, lo: int, hi: int) -> SplitParams:
-    """Re-index per-feature STATIC config to a [lo, hi) feature tile."""
+    """Re-index per-feature STATIC config to a [lo, hi) feature tile.
+
+    The mode flags must stay UNIFORM across tiles even when a tile's slice
+    is trivial: leaf output bounds apply to any split of a constrained leaf
+    (not just splits on constrained features), and contri mode rescales
+    gains to penalized improvement — folding a raw-gain tile against a
+    penalized tile would compare incompatible scales. Hence the
+    monotone_clamp/contri_active force-flags."""
     import dataclasses
     kw = {}
     if sp.cat_features:
@@ -737,9 +744,11 @@ def _tile_split_params(sp: SplitParams, lo: int, hi: int) -> SplitParams:
     if sp.monotone_constraints:
         mc = list(sp.monotone_constraints)
         kw["monotone_constraints"] = tuple((mc + [0] * hi)[lo:hi])
+        kw["monotone_clamp"] = sp.has_monotone
     if sp.feature_contri:
         fc = list(sp.feature_contri)
         kw["feature_contri"] = tuple((fc + [1.0] * hi)[lo:hi])
+        kw["contri_active"] = sp.has_contri
     return dataclasses.replace(sp, **kw) if kw else sp
 
 

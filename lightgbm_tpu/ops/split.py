@@ -71,13 +71,22 @@ class SplitParams:
     cegb_coupled: bool = False
     cegb_lazy: bool = False
 
+    # force-flags for feature-TILED search (grow_depthwise lean mode): a tile
+    # whose monotone/contri slice is trivial must still apply the leaf-bound
+    # clamp and the penalized-gain scale so candidates fold consistently
+    # across tiles
+    monotone_clamp: bool = False
+    contri_active: bool = False
+
     @property
     def has_monotone(self) -> bool:
-        return any(m != 0 for m in self.monotone_constraints)
+        return (any(m != 0 for m in self.monotone_constraints)
+                or self.monotone_clamp)
 
     @property
     def has_contri(self) -> bool:
-        return any(c != 1.0 for c in self.feature_contri)
+        return (any(c != 1.0 for c in self.feature_contri)
+                or self.contri_active)
 
     def contri_array(self, f: int) -> np.ndarray:
         """[F] f32 gain multipliers in grower-column space: the registered

@@ -166,7 +166,11 @@ def main():
 
     if not args.skip_ref:
         train_path = os.path.join(args.workdir, f"train_{args.rows}.tsv")
-        valid_path = os.path.join(args.workdir, f"valid_{args.valid_rows}.tsv")
+        # valid rows depend on the TRAIN size too (they are carved from the
+        # same generated block) — keying the file only by valid_rows let a
+        # 10M run reuse a 1M run's valid file and score garbage AUC
+        valid_path = os.path.join(
+            args.workdir, f"valid_{args.valid_rows}_of_{args.rows}.tsv")
         if not os.path.exists(train_path):
             print(f"writing {train_path} ...", file=sys.stderr)
             write_tsv(train_path, X, y)

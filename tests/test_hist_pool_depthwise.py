@@ -94,3 +94,46 @@ def test_lean_data_parallel():
     assert a._gbdt.gp.lean_ft > 0 and b._gbdt.gp.lean_ft > 0
     np.testing.assert_allclose(a.predict(X[:200]), b.predict(X[:200]),
                                rtol=0.05, atol=5e-3)
+
+
+def test_lean_monotone_constraint_binds():
+    """Monotonicity must HOLD in lean mode even for tiles whose constraint
+    slice is all-zero (regression: sliced SplitParams once dropped
+    has_monotone for those tiles, skipping the leaf-bound clamp)."""
+    rng = np.random.RandomState(21)
+    n, f = 4000, 12
+    X = rng.randn(n, f)
+    y = 2.0 * X[:, 0] + np.sin(3 * X[:, 1]) + 0.2 * rng.randn(n)
+    p = {"objective": "regression", "num_leaves": 31, "verbosity": -1,
+         "min_data_in_leaf": 5, "max_bin": 32, "histogram_pool_size": 0.05,
+         "monotone_constraints": [1] + [0] * (f - 1)}
+    bst = lgb.train(p, lgb.Dataset(X, label=y), num_boost_round=15)
+    assert bst._gbdt.gp.lean_ft > 0 and bst._gbdt.gp.lean_ft < f
+    # sweep feature 0 while holding others fixed: predictions must be
+    # non-decreasing
+    base = np.tile(np.median(X, axis=0), (50, 1))
+    base[:, 0] = np.linspace(X[:, 0].min(), X[:, 0].max(), 50)
+    pred = bst.predict(base)
+    assert np.all(np.diff(pred) >= -1e-6), "monotonicity violated in lean mode"
+
+
+def test_lean_contri_gain_scale_consistent():
+    """feature_contri + min_gain in lean mode must match the default grower
+    (regression: all-1.0 contri slices once folded raw gains against
+    penalized gains across tiles)."""
+    rng = np.random.RandomState(22)
+    n, f = 2000, 12
+    X = rng.randn(n, f)
+    y = (X[:, 0] * 2 + X[:, 5] + 0.3 * rng.randn(n) > 0).astype(np.float64)
+    contri = [0.5] + [1.0] * (f - 1)
+    p = {"objective": "binary", "num_leaves": 15, "verbosity": -1,
+         "min_data_in_leaf": 5, "max_bin": 32, "min_gain_to_split": 1.0,
+         "feature_contri": contri, "enable_bundle": False}
+    a = lgb.train(p, lgb.Dataset(X, label=y), num_boost_round=4)
+    b = lgb.train({**p, "histogram_pool_size": 0.05},
+                  lgb.Dataset(X, label=y), num_boost_round=4)
+    assert b._gbdt.gp.lean_ft > 0 and b._gbdt.gp.lean_ft < f
+    ta, tb = a._ensure_host_trees(), b._ensure_host_trees()
+    assert [t.num_leaves for t in ta] == [t.num_leaves for t in tb]
+    np.testing.assert_allclose(a.predict(X[:200]), b.predict(X[:200]),
+                               rtol=0.05, atol=5e-3)

@@ -162,3 +162,30 @@ def test_feature_contri_noop_with_min_gain():
     assert [t.num_leaves for t in ta] == [t.num_leaves for t in tb]
     np.testing.assert_allclose(a.predict(X[:100]), b.predict(X[:100]),
                                rtol=1e-4)
+
+
+def test_auc_mu_weights_consumed():
+    """auc_mu with a custom class-weight matrix (reference: AucMuMetric
+    class_weights_, multiclass_metric.hpp:187) changes the metric value."""
+    rng = np.random.RandomState(0)
+    X = rng.randn(600, 5)
+    y = rng.randint(0, 3, 600).astype(np.float64)
+    base = {"objective": "multiclass", "num_class": 3, "verbosity": -1,
+            "metric": "auc_mu", "num_leaves": 7}
+    ev1, ev2 = {}, {}
+    lgb.train(base, lgb.Dataset(X, label=y, free_raw_data=False), 3,
+              valid_sets=[lgb.Dataset(X, label=y)],
+              callbacks=[lgb.record_evaluation(ev1)])
+    wts = [0, 5, 1, 1, 0, 1, 1, 1, 0]
+    lgb.train(dict(base, auc_mu_weights=wts),
+              lgb.Dataset(X, label=y, free_raw_data=False), 3,
+              valid_sets=[lgb.Dataset(X, label=y)],
+              callbacks=[lgb.record_evaluation(ev2)])
+    v1 = list(ev1.values())[0]["auc_mu"]
+    v2 = list(ev2.values())[0]["auc_mu"]
+    assert all(0.0 <= v <= 1.0 for v in v1 + v2)
+    assert v1 != v2, "custom auc_mu_weights must change the metric"
+    with pytest.raises(LightGBMError):
+        lgb.train(dict(base, auc_mu_weights=[1.0, 2.0]),
+                  lgb.Dataset(X, label=y, free_raw_data=False), 1,
+                  valid_sets=[lgb.Dataset(X, label=y)])
