@@ -1,0 +1,73 @@
+"""Python side of the minimal C ABI (native/capi.cpp).
+
+The reference exposes 64 C functions (c_api.h:52-1018) because its core IS
+C++; here the core is Python/JAX, so the stable non-Python surface is a thin
+C library embedding CPython that forwards into these helpers. Arguments
+cross the boundary as raw addresses + sizes; numpy views them without
+copies. Keep signatures primitive (ints/strings) so the C side stays a
+dozen PyObject_CallMethod calls.
+"""
+from __future__ import annotations
+
+import ctypes
+import os
+
+import numpy as np
+
+# platform override for embedded hosts: the axon TPU plugin ignores the
+# JAX_PLATFORMS env var, so a C host that must stay off the (possibly
+# already-claimed) TPU sets LGBM_TPU_FORCE_PLATFORM=cpu and this module
+# applies it via jax.config BEFORE any device is touched
+_force = os.environ.get("LGBM_TPU_FORCE_PLATFORM")
+if _force:
+    import jax
+    jax.config.update("jax_platforms", _force)
+
+
+def train_from_config(config_path: str) -> int:
+    """task=train driven by a config file (reference: LGBM_* has no direct
+    analog — the CLI path serves; Application::Run application.h:37)."""
+    from .app import main
+    return int(main([f"config={config_path}"]) or 0)
+
+
+def booster_from_file(path: str):
+    """Opaque Booster handle (reference: LGBM_BoosterCreateFromModelfile,
+    c_api.h:387)."""
+    from .basic import Booster
+    return Booster(model_file=path)
+
+
+def booster_from_string(model_str: str):
+    from .basic import Booster
+    return Booster(model_str=model_str)
+
+
+def num_feature(booster) -> int:
+    return int(booster.num_feature())
+
+
+def num_trees(booster) -> int:
+    return int(booster.num_trees())
+
+
+def predict_for_mat(booster, data_addr: int, nrow: int, ncol: int,
+                    raw_score: int, pred_leaf: int, out_addr: int,
+                    out_cap: int) -> int:
+    """Dense f64 row-major matrix prediction (reference:
+    LGBM_BoosterPredictForMat, c_api.h:822). Returns the number of doubles
+    written, or -1 if out_cap is too small."""
+    src = (ctypes.c_double * (nrow * ncol)).from_address(data_addr)
+    x = np.frombuffer(src, dtype=np.float64).reshape(nrow, ncol)
+    out = booster.predict(x, raw_score=bool(raw_score),
+                          pred_leaf=bool(pred_leaf))
+    out = np.ascontiguousarray(np.asarray(out, dtype=np.float64)).reshape(-1)
+    if out.size > out_cap:
+        return -1
+    ctypes.memmove(out_addr, out.ctypes.data, out.nbytes)
+    return int(out.size)
+
+
+def save_model(booster, path: str) -> int:
+    booster.save_model(path)
+    return 0

@@ -268,9 +268,15 @@ def _auc_mu_with_config(config):
     if len(wts) != k * k:
         log.fatal(f"auc_mu_weights must have num_class^2 = {k * k} elements "
                   f"(got {len(wts)})")
+    A = np.asarray(wts, np.float64).reshape(k, k)
+    # reference conventions (config.cpp:163-177): the diagonal is forced to
+    # zero and off-diagonal entries must be non-zero
+    if np.any((A == 0) & ~np.eye(k, dtype=bool)):
+        log.fatal("all off-diagonal auc_mu_weights must be non-zero")
+    A = A * (1.0 - np.eye(k))
 
     def fn(label, prob, w):
-        return _auc_mu(label, prob, w, weights_matrix=wts)
+        return _auc_mu(label, prob, w, weights_matrix=A)
     return fn
 
 

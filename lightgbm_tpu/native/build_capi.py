@@ -1,0 +1,67 @@
+"""Build liblightgbm_tpu.so — the minimal stable C ABI (capi.cpp).
+
+Links against the current interpreter's libpython via sysconfig (the
+reference builds lib_lightgbm.so with CMake; here one g++ line suffices).
+Content-hash cached like the fastio build. Returns the .so path or None.
+"""
+from __future__ import annotations
+
+import hashlib
+import os
+import subprocess
+import sysconfig
+import tempfile
+from typing import Optional
+
+_HERE = os.path.dirname(os.path.abspath(__file__))
+_SRC = os.path.join(_HERE, "capi.cpp")
+
+
+def build_capi() -> Optional[str]:
+    with open(_SRC, "rb") as fh:
+        digest = hashlib.sha256(fh.read()).hexdigest()[:16]
+    cache_dir = os.environ.get("LGBM_TPU_NATIVE_CACHE",
+                               os.path.join(tempfile.gettempdir(),
+                                            "lgbm_tpu_native"))
+    os.makedirs(cache_dir, exist_ok=True)
+    so_path = os.path.join(cache_dir, f"liblightgbm_tpu_{digest}.so")
+    if os.path.exists(so_path):
+        return so_path
+    inc = sysconfig.get_path("include")
+    libdir = sysconfig.get_config_var("LIBDIR") or ""
+    ldlib = sysconfig.get_config_var("LDLIBRARY") or ""
+    # "libpython3.12.so" -> "python3.12"
+    pylib = ldlib
+    for pre in ("lib",):
+        if pylib.startswith(pre):
+            pylib = pylib[len(pre):]
+    for suf in (".so", ".a", ".dylib"):
+        if pylib.endswith(suf):
+            pylib = pylib[: -len(suf)]
+    tmp = so_path + f".tmp{os.getpid()}"
+    cmd = ["g++", "-O2", "-std=c++17", "-shared", "-fPIC",
+           f"-I{inc}", _SRC, "-o", tmp,
+           f"-L{libdir}", f"-l{pylib}", f"-Wl,-rpath,{libdir}"]
+    try:
+        subprocess.run(cmd, check=True, capture_output=True, timeout=180)
+        os.replace(tmp, so_path)
+        return so_path
+    except subprocess.CalledProcessError as e:
+        import sys
+        print(f"capi build failed:\n{e.stderr.decode('utf-8', 'replace')}",
+              file=sys.stderr)
+        return None
+    except Exception as e:
+        import sys
+        print(f"capi build failed: {e}", file=sys.stderr)
+        return None
+    finally:
+        if os.path.exists(tmp):
+            try:
+                os.unlink(tmp)
+            except OSError:
+                pass
+
+
+if __name__ == "__main__":
+    print(build_capi() or "BUILD FAILED")

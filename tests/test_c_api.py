@@ -1,0 +1,164 @@
+"""C ABI smoke test (reference analog: tests/c_api_test/test_.py driving
+lib_lightgbm.so through ctypes). Builds liblightgbm_tpu.so (capi.cpp) and
+drives train-from-config + booster load + dense-matrix predict through the
+raw C functions, asserting exact agreement with the Python surface."""
+import ctypes
+import os
+import subprocess
+import sys
+
+import numpy as np
+import pytest
+
+import lightgbm_tpu as lgb
+
+
+@pytest.fixture(scope="module")
+def capi():
+    from lightgbm_tpu.native.build_capi import build_capi
+    so = build_capi()
+    if so is None:
+        pytest.skip("no native toolchain / libpython to build the C ABI")
+    lib = ctypes.CDLL(so)
+    lib.LGBMTPU_GetLastError.restype = ctypes.c_char_p
+    lib.LGBMTPU_TrainFromConfig.argtypes = [ctypes.c_char_p]
+    lib.LGBMTPU_BoosterCreateFromModelfile.argtypes = [
+        ctypes.c_char_p, ctypes.POINTER(ctypes.c_void_p)]
+    lib.LGBMTPU_BoosterNumFeature.argtypes = [
+        ctypes.c_void_p, ctypes.POINTER(ctypes.c_int)]
+    lib.LGBMTPU_BoosterNumTrees.argtypes = [
+        ctypes.c_void_p, ctypes.POINTER(ctypes.c_int)]
+    lib.LGBMTPU_BoosterPredictForMat.argtypes = [
+        ctypes.c_void_p, ctypes.POINTER(ctypes.c_double), ctypes.c_longlong,
+        ctypes.c_int, ctypes.c_int, ctypes.c_int,
+        ctypes.POINTER(ctypes.c_double), ctypes.c_longlong,
+        ctypes.POINTER(ctypes.c_longlong)]
+    lib.LGBMTPU_BoosterSaveModel.argtypes = [ctypes.c_void_p, ctypes.c_char_p]
+    lib.LGBMTPU_BoosterFree.argtypes = [ctypes.c_void_p]
+    return lib
+
+
+def test_c_api_booster_roundtrip(capi, tmp_path):
+    rng = np.random.RandomState(0)
+    X = rng.randn(500, 6)
+    y = (X[:, 0] + 0.5 * X[:, 1] > 0).astype(np.float64)
+    bst = lgb.train({"objective": "binary", "verbosity": -1, "num_leaves": 15},
+                    lgb.Dataset(X, label=y), 10)
+    model_path = str(tmp_path / "model.txt")
+    bst.save_model(model_path)
+
+    h = ctypes.c_void_p()
+    rc = capi.LGBMTPU_BoosterCreateFromModelfile(model_path.encode(),
+                                                 ctypes.byref(h))
+    assert rc == 0, capi.LGBMTPU_GetLastError()
+
+    nf = ctypes.c_int()
+    assert capi.LGBMTPU_BoosterNumFeature(h, ctypes.byref(nf)) == 0
+    assert nf.value == 6
+    nt = ctypes.c_int()
+    assert capi.LGBMTPU_BoosterNumTrees(h, ctypes.byref(nt)) == 0
+    assert nt.value == 10
+
+    xt = np.ascontiguousarray(X[:100], dtype=np.float64)
+    out = np.zeros(100, dtype=np.float64)
+    written = ctypes.c_longlong()
+    rc = capi.LGBMTPU_BoosterPredictForMat(
+        h, xt.ctypes.data_as(ctypes.POINTER(ctypes.c_double)), 100, 6, 0, 0,
+        out.ctypes.data_as(ctypes.POINTER(ctypes.c_double)), out.size,
+        ctypes.byref(written))
+    assert rc == 0, capi.LGBMTPU_GetLastError()
+    assert written.value == 100
+    np.testing.assert_allclose(out, bst.predict(xt), rtol=1e-9)
+
+    save2 = str(tmp_path / "resaved.txt")
+    assert capi.LGBMTPU_BoosterSaveModel(h, save2.encode()) == 0
+    b2 = lgb.Booster(model_file=save2)
+    np.testing.assert_allclose(b2.predict(xt), out, rtol=1e-9)
+    assert capi.LGBMTPU_BoosterFree(h) == 0
+
+
+def test_c_api_error_reporting(capi):
+    h = ctypes.c_void_p()
+    rc = capi.LGBMTPU_BoosterCreateFromModelfile(b"/no/such/model.txt",
+                                                 ctypes.byref(h))
+    assert rc == -1
+    assert capi.LGBMTPU_GetLastError()
+
+
+def test_c_api_train_from_config(capi, tmp_path):
+    rng = np.random.RandomState(1)
+    X = rng.randn(400, 4)
+    y = (X[:, 0] > 0).astype(np.float64)
+    data = str(tmp_path / "tr.tsv")
+    np.savetxt(data, np.column_stack([y, X]), delimiter="\t")
+    model = str(tmp_path / "m.txt")
+    conf = tmp_path / "t.conf"
+    conf.write_text(f"task=train\ndata={data}\nobjective=binary\n"
+                    f"num_leaves=7\nnum_iterations=3\n"
+                    f"output_model={model}\nverbosity=-1\n")
+    rc = capi.LGBMTPU_TrainFromConfig(str(conf).encode())
+    assert rc == 0, capi.LGBMTPU_GetLastError()
+    assert os.path.exists(model)
+    b = lgb.Booster(model_file=model)
+    assert b.num_trees() == 3
+
+
+def test_c_api_from_pure_c_host(capi, tmp_path):
+    """The library must also work from a NON-Python host: compile a tiny C
+    program that dlopens nothing but links the ABI, embeds the interpreter,
+    loads a model and predicts (the R/SWIG usage shape)."""
+    import sysconfig
+    from lightgbm_tpu.native.build_capi import build_capi
+    so = build_capi()
+    rng = np.random.RandomState(2)
+    X = rng.randn(300, 3)
+    y = (X[:, 0] > 0).astype(np.float64)
+    bst = lgb.train({"objective": "binary", "verbosity": -1, "num_leaves": 7},
+                    lgb.Dataset(X, label=y), 5)
+    model_path = str(tmp_path / "cm.txt")
+    bst.save_model(model_path)
+    expected = bst.predict(np.ascontiguousarray(X[:5]))
+
+    csrc = tmp_path / "host.c"
+    csrc.write_text(r'''
+#include <stdio.h>
+#include <stdlib.h>
+extern const char* LGBMTPU_GetLastError(void);
+extern int LGBMTPU_BoosterCreateFromModelfile(const char*, void**);
+extern int LGBMTPU_BoosterPredictForMat(void*, const double*, long long,
+    int, int, int, double*, long long, long long*);
+int main(int argc, char** argv) {
+  void* h; double out[5]; long long n;
+  if (LGBMTPU_BoosterCreateFromModelfile(argv[1], &h)) {
+    fprintf(stderr, "%s\n", LGBMTPU_GetLastError()); return 1; }
+  double* x = malloc(5 * 3 * sizeof(double));
+  FILE* f = fopen(argv[2], "rb");
+  if (fread(x, sizeof(double), 15, f) != 15) return 2;
+  fclose(f);
+  if (LGBMTPU_BoosterPredictForMat(h, x, 5, 3, 0, 0, out, 5, &n)) {
+    fprintf(stderr, "%s\n", LGBMTPU_GetLastError()); return 3; }
+  for (int i = 0; i < 5; ++i) printf("%.10f\n", out[i]);
+  return 0;
+}
+''')
+    host = str(tmp_path / "host")
+    try:
+        subprocess.run(["gcc", str(csrc), so, "-o", host,
+                        f"-Wl,-rpath,{os.path.dirname(so)}"],
+                       check=True, capture_output=True, timeout=120)
+    except Exception:
+        pytest.skip("no C toolchain for the host program")
+    xbin = tmp_path / "x.bin"
+    np.ascontiguousarray(X[:5], dtype=np.float64).tofile(xbin)
+    env = dict(os.environ)
+    env["PYTHONPATH"] = "/root/repo" + os.pathsep + env.get("PYTHONPATH", "")
+    # the axon plugin ignores JAX_PLATFORMS; capi_impl reads this and applies
+    # jax.config.update so the embedded host never touches the (possibly
+    # already-claimed) TPU
+    env["LGBM_TPU_FORCE_PLATFORM"] = "cpu"
+    env["JAX_PLATFORMS"] = "cpu"
+    r = subprocess.run([host, model_path, str(xbin)], capture_output=True,
+                       timeout=300, env=env, cwd="/root/repo")
+    assert r.returncode == 0, r.stderr.decode()[-500:]
+    got = np.asarray([float(v) for v in r.stdout.decode().split()])
+    np.testing.assert_allclose(got, expected, rtol=1e-6)

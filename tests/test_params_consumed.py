@@ -189,3 +189,26 @@ def test_auc_mu_weights_consumed():
         lgb.train(dict(base, auc_mu_weights=[1.0, 2.0]),
                   lgb.Dataset(X, label=y, free_raw_data=False), 1,
                   valid_sets=[lgb.Dataset(X, label=y)])
+
+
+def test_auc_mu_weights_diagonal_and_zero_rules():
+    """Reference conventions (config.cpp:163-177): diagonal forced to zero,
+    off-diagonal zeros rejected."""
+    rng = np.random.RandomState(3)
+    X = rng.randn(300, 4)
+    y = rng.randint(0, 2, 300).astype(np.float64)
+    base = {"objective": "multiclass", "num_class": 2, "verbosity": -1,
+            "metric": "auc_mu", "num_leaves": 7}
+    # all-ones matrix: the forced-zero diagonal makes it the DEFAULT matrix,
+    # so the metric must be informative (not pinned at 0.5 by t1 == 0)
+    ev = {}
+    lgb.train(dict(base, auc_mu_weights=[1.0, 1.0, 1.0, 1.0]),
+              lgb.Dataset(X, label=y, free_raw_data=False), 3,
+              valid_sets=[lgb.Dataset(X, label=y)],
+              callbacks=[lgb.record_evaluation(ev)])
+    vals = list(ev.values())[0]["auc_mu"]
+    assert vals[-1] != 0.5
+    with pytest.raises(LightGBMError):
+        lgb.train(dict(base, auc_mu_weights=[0.0, 0.0, 1.0, 0.0]),
+                  lgb.Dataset(X, label=y, free_raw_data=False), 1,
+                  valid_sets=[lgb.Dataset(X, label=y)])
