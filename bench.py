@@ -90,14 +90,15 @@ def main():
     prob = 1.0 / (1.0 + np.exp(-np.asarray(booster.raw_train_score())))
     auc = float(_auc(jnp.asarray(y), jnp.asarray(prob), None))
     ref_auc = None
+    parity_doc = {}
     parity_path = os.path.join(os.path.dirname(os.path.abspath(__file__)),
                                "PARITY_BENCH.json")
     if os.path.exists(parity_path):
         with open(parity_path) as fh:
-            entries = json.load(fh).get("entries", [])
+            parity_doc = json.load(fh)
         key = {"rows": n_rows, "iters": n_iters, "leaves": num_leaves,
                "bins": max_bin}
-        e = next((e for e in entries
+        e = next((e for e in parity_doc.get("entries", [])
                   if all(e.get(k) == v for k, v in key.items())), None)
         if e:
             ref_auc = e["ref_train_auc"]
@@ -127,6 +128,18 @@ def main():
         "train_auc": round(auc, 4),
         **({"ref_auc": round(ref_auc, 4)} if ref_auc is not None else {}),
     }
+    # surface the 500-iteration parity headline (scripts/parity_bench.py)
+    par = parity_doc.get("parity") or {}
+    if par.get("tpu_valid_auc"):
+        result["parity_500iter"] = {
+            "rows": par["rows"], "iters": par["iters"],
+            "ref_valid_auc": par["ref_valid_auc"],
+            "tpu_valid_auc": par["tpu_valid_auc"],
+            "delta_valid_auc": par["delta_valid_auc"],
+            "speedup_vs_ref_cli": round(
+                par["ref_train_time_s"] / max(par["tpu_train_time_s"], 1e-9),
+                2),
+        }
     print(json.dumps(result))
     print(f"# rows={n_rows} iters={n_iters} leaves={num_leaves} bins={max_bin} "
           f"gen={t_gen:.1f}s bin={t_bin:.1f}s compile+first={t_compile:.1f}s "

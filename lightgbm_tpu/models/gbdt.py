@@ -760,6 +760,12 @@ class GBDT:
                 if all(int(x) <= 1 for x in old):
                     self._pop_trailing_stumps()
                     return True
+            # bound the in-flight dispatch queue: ~50 unsynced iterations
+            # (hundreds of queued programs) reproducibly crash the tunneled
+            # TPU worker; a sync every 20th iteration keeps arbitrarily long
+            # train() loops safe at ~1-2% pipeline cost
+            if self.iter_ % 20 == 0:
+                jax.block_until_ready(self.train_score)
             return False
         return self._grow_and_update_slow(grad, hess)
 

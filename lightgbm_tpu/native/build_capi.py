@@ -47,13 +47,13 @@ def build_capi() -> Optional[str]:
         os.replace(tmp, so_path)
         return so_path
     except subprocess.CalledProcessError as e:
-        import sys
-        print(f"capi build failed:\n{e.stderr.decode('utf-8', 'replace')}",
-              file=sys.stderr)
+        from ..utils import log
+        log.warning("C ABI build FAILED:\n"
+                    + e.stderr.decode("utf-8", "replace"))
         return None
     except Exception as e:
-        import sys
-        print(f"capi build failed: {e}", file=sys.stderr)
+        from ..utils import log
+        log.warning(f"C ABI build FAILED: {e}")
         return None
     finally:
         if os.path.exists(tmp):

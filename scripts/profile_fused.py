@@ -1,0 +1,28 @@
+import sys, os
+sys.path.insert(0, "/root/repo")
+import jax, jax.numpy as jnp, numpy as np
+from lightgbm_tpu.ops import histogram as H
+from lightgbm_tpu.ops import pallas_hist as PH
+from lightgbm_tpu.utils.timer import time_op_in_jit
+
+n, f, b, L = 10_000_000, 28, 64, 255
+rng = np.random.RandomState(0)
+bins_T = jnp.asarray(rng.randint(0, b, size=(f, n), dtype=np.uint8))
+gq = jnp.asarray(rng.randint(-127, 128, n, dtype=np.int8))
+hq = jnp.asarray(rng.randint(0, 128, n, dtype=np.int8))
+cq = jnp.ones(n, jnp.int8)
+lid = jnp.asarray(rng.randint(0, L, n, dtype=np.int32))
+
+for s in (1, 2, 8, 32, 64, 127):
+    tables = H.RouteTables(
+        feat=jnp.zeros(L, jnp.int32), thr=jnp.full(L, b // 2, jnp.int32),
+        dleft=jnp.zeros(L, jnp.int32), new_leaf=jnp.arange(L, dtype=jnp.int32),
+        slot_left=jnp.zeros(L, jnp.int32),
+        slot_right=jnp.minimum(jnp.ones(L, jnp.int32), s - 1))
+    ms = time_op_in_jit(
+        lambda i, bt, ll: PH.hist_routed_fused_q8(
+            bt, gq, hq, cq, jnp.minimum(ll + i, L - 1), tables,
+            jnp.full(f, b + 1, jnp.int32), s, b,
+            jnp.float32(1.0), jnp.float32(1.0), L)[0].sum(),
+        bins_T, lid, K=4, reps=2)
+    print(f"fused S={s:4d}: {ms:7.2f} ms")
