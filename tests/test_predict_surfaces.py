@@ -1,0 +1,72 @@
+"""Prediction API surfaces untested until round 4: pred_leaf and
+num_iteration slicing (reference analogs: test_engine.py pred_leaf cases and
+Booster.predict(num_iteration=...))."""
+import numpy as np
+
+import lightgbm_tpu as lgb
+
+
+def _model(rounds=8):
+    rng = np.random.RandomState(6)
+    X = rng.randn(600, 5)
+    y = (X[:, 0] + 0.5 * X[:, 1] > 0).astype(np.float64)
+    bst = lgb.train({"objective": "binary", "verbosity": -1, "num_leaves": 15,
+                     "min_data_in_leaf": 5},
+                    lgb.Dataset(X, label=y), rounds)
+    return bst, X
+
+
+def _leaf_sum(trees, leaves):
+    """Sum of each row's indexed leaf values across trees."""
+    acc = np.zeros(leaves.shape[0])
+    for t, tr in enumerate(trees):
+        acc += np.asarray(tr.leaf_value)[leaves[:, t].astype(int)]
+    return acc
+
+
+def test_pred_leaf_shape_and_consistency():
+    bst, X = _model()
+    trees = bst._ensure_host_trees()
+    leaves = bst.predict(X[:50], pred_leaf=True)
+    assert leaves.shape == (50, len(trees))
+    # indices valid per tree
+    for t, tr in enumerate(trees):
+        assert leaves[:, t].min() >= 0
+        assert leaves[:, t].max() < tr.num_leaves
+    # summing the indexed leaf values reproduces the raw score exactly
+    raw = bst.predict(X[:50], raw_score=True)
+    np.testing.assert_allclose(_leaf_sum(trees, leaves), raw,
+                               rtol=1e-5, atol=1e-6)
+
+
+def test_predict_num_iteration_slicing():
+    bst, X = _model(rounds=10)
+    raw_full = bst.predict(X[:100], raw_score=True)
+    raw_all = bst.predict(X[:100], raw_score=True, num_iteration=10)
+    np.testing.assert_allclose(raw_full, raw_all, rtol=1e-7)
+    raw_3 = bst.predict(X[:100], raw_score=True, num_iteration=3)
+    assert not np.allclose(raw_3, raw_full)
+    # the 3-iteration slice must equal the sum of the first 3 trees' values
+    trees = bst._ensure_host_trees()[:3]
+    leaves = bst.predict(X[:100], pred_leaf=True)[:, :3]
+    np.testing.assert_allclose(_leaf_sum(trees, leaves), raw_3,
+                               rtol=1e-5, atol=1e-6)
+
+
+def test_predict_uses_best_iteration_after_early_stop():
+    rng = np.random.RandomState(7)
+    X = rng.randn(800, 5)
+    y = (X[:, 0] > 0).astype(np.float64)
+    Xv = rng.randn(300, 5)
+    yv = (Xv[:, 0] > 0).astype(np.float64)
+    ds = lgb.Dataset(X, label=y)
+    bst = lgb.train({"objective": "binary", "verbosity": -1, "num_leaves": 31,
+                     "learning_rate": 0.8, "metric": "binary_logloss"},
+                    ds, 200,
+                    valid_sets=[lgb.Dataset(Xv, label=yv, reference=ds)],
+                    early_stopping_rounds=3, verbose_eval=False)
+    assert 0 < bst.best_iteration < 200
+    # default predict slices at best_iteration
+    p_default = bst.predict(Xv, raw_score=True)
+    p_best = bst.predict(Xv, raw_score=True, num_iteration=bst.best_iteration)
+    np.testing.assert_allclose(p_default, p_best, rtol=1e-7)
