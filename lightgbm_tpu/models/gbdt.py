@@ -561,6 +561,14 @@ class GBDT:
         k = self.num_tree_per_iteration
         gp = self.gp
         obj = self.objective
+        if (not custom and gp.quant and obj is not None
+                and getattr(obj, "is_constant_hessian", False)):
+            # auto-gradient path with an IsConstantHessian objective: the q8
+            # histogram kernels can drop the hessian channel (GrowParams
+            # docstring). Custom/GOSS gradients keep all 3 channels — their
+            # per-row hessians are not h_const * bag01.
+            import dataclasses
+            gp = dataclasses.replace(gp, const_hess=True)
         grow_fn = self._grow_fn()
         bundle = self._bundle_dev
         forced = self._forced_dev

@@ -38,6 +38,13 @@ class GrowParams:
     # int8 quantized-gradient histograms (LightGBM 4.x technique; applies to
     # the depthwise/pallas path — leaf values are renewed from exact sums)
     quant: bool = False
+    # constant-hessian channel elision (reference: CONST_HESSIAN OpenCL
+    # kernel variants, ocl/histogram256.cl:18-60): rows carry
+    # h = h_const * bag01, so the q8 kernels drop the hessian channel and
+    # reconstruct it from the count channel — set only by the fused
+    # auto-gradient step for IsConstantHessian objectives (never for custom
+    # gradients / GOSS-amplified channels, where h varies per row)
+    const_hess: bool = False
     # voting-parallel: top-k features elected per level for histogram exchange
     # (reference: VotingParallelTreeLearner, top_k config); 0 = off
     voting_top_k: int = 0

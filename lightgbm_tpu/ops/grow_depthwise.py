@@ -197,11 +197,13 @@ def grow_tree_depthwise(bins: jnp.ndarray, g: jnp.ndarray, h: jnp.ndarray,
     # int8 quantized channels, built once per tree; per-shard scales are fine
     # under data-parallel because every histogram is dequantized to f32 before
     # the psum (each shard contributes real-valued mass)
-    quant = H.make_quant(g, h, c, qseed) if gp.quant else None
+    quant = (H.make_quant(g, h, c, qseed, const_hess=gp.const_hess)
+             if gp.quant else None)
     # segment packing requires the quantized pallas path, serial execution,
     # and no forced-split overrides (voting re-measures both children and has
     # its own exchange path)
-    packed = (gp.packed and quant is not None and bins_T is not None
+    packed = (gp.packed and quant is not None and quant.hq is not None
+              and bins_T is not None
               and not gp.axis_name and gp.voting_top_k == 0
               and forced is None and not sp.has_cegb
               and n * f < (1 << 31))  # flat row*F+feat index stays in int32
@@ -810,10 +812,12 @@ def grow_tree_depthwise_lean(bins: jnp.ndarray, g, h, c, num_bins, na_bin,
     # quantization mirrors hist_routed exactly (histogram.py:433-436): the
     # q8 kernel on the pallas path, per-row dequantized channels elsewhere —
     # so lean and default growers see the SAME histogram numbers per impl
-    quant = H.make_quant(g, h, c, qseed) if gp.quant else None
+    quant = (H.make_quant(g, h, c, qseed, const_hess=gp.const_hess)
+             if gp.quant else None)
     if quant is not None and not use_pallas:
         gm = quant.gq.astype(jnp.float32) * (quant.scale_g / 127.0)
-        hm = quant.hq.astype(jnp.float32) * (quant.scale_h / 127.0)
+        hm = (quant.hq if quant.hq is not None else quant.cq).astype(
+            jnp.float32) * (quant.scale_h / 127.0)
         cm = quant.cq.astype(jnp.float32)
     else:
         gm, hm, cm = g, h, c
@@ -823,9 +827,11 @@ def grow_tree_depthwise_lean(bins: jnp.ndarray, g, h, c, num_bins, na_bin,
         """[n_slots, 3, hi-lo, B] histograms of one feature tile, psum'd."""
         if quant is not None and use_pallas:
             from .pallas_hist import hist_pallas_q8
-            ht = hist_pallas_q8(bins_T[lo:hi], quant.gq, quant.hq, quant.cq,
+            hq, ch = H._q8_h_arg(quant)
+            ht = hist_pallas_q8(bins_T[lo:hi], quant.gq, hq, quant.cq,
                                 slot, n_slots, B, quant.scale_g,
-                                quant.scale_h, interpret=interp)
+                                quant.scale_h, const_hess=ch,
+                                interpret=interp)
         else:
             ht = H.hist_per_leaf(bins[:, lo:hi], gm, hm, cm, slot, n_slots, B,
                                  gp.hist_impl,

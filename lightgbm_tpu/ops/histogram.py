@@ -362,18 +362,35 @@ def quantize_sr(x: jnp.ndarray, seed, salt: int):
 
 
 class QuantChannels(NamedTuple):
-    """Per-tree quantized row channels + scales (built once per tree)."""
+    """Per-tree quantized row channels + scales (built once per tree).
+
+    ``hq is None`` signals constant-hessian elision (reference analog: the
+    CONST_HESSIAN OpenCL kernel variants, ocl/histogram256.cl:18-60): rows
+    carry h = h_const * bag01, so the histogram hessian channel is exactly
+    ``count * scale_h / 127`` and the kernels skip it — the MXU contraction
+    shrinks from 3 to 2 int8 channels. Bit-identical to the quantized path:
+    quantize_sr on a {0, h_const} vector yields hq = 127 * cq exactly."""
     gq: jnp.ndarray      # [N] int8
-    hq: jnp.ndarray      # [N] int8
+    hq: Optional[jnp.ndarray]   # [N] int8, or None when hessian is constant
     cq: jnp.ndarray      # [N] int8 0/1
     scale_g: jnp.ndarray  # f32 scalar
     scale_h: jnp.ndarray  # f32 scalar
 
 
-def make_quant(g, h, c, seed) -> QuantChannels:
+def make_quant(g, h, c, seed, const_hess: bool = False) -> QuantChannels:
     gq, sg = quantize_sr(g, seed, salt=1)
+    if const_hess:
+        # scale_h = 127 * h_const so every dequant site's out * scale_h/127
+        # reconstructs h_const * count without a dedicated scalar
+        return QuantChannels(gq, None, c.astype(jnp.int8), sg,
+                             127.0 * jnp.max(h).astype(jnp.float32))
     hq, sh = quantize_sr(h, seed, salt=2)
     return QuantChannels(gq, hq, c.astype(jnp.int8), sg, sh)
+
+
+def _q8_h_arg(quant: QuantChannels):
+    """(hq array to pass, const_hess flag) for the q8 kernels."""
+    return (quant.cq, True) if quant.hq is None else (quant.hq, False)
 
 
 # ---------------------------------------------------------------------------
@@ -397,14 +414,16 @@ def hist_leaf(bins, g, h, c, num_bins, impl="auto", bins_T=None, quant=None):
         from .pallas_hist import hist_pallas_q8
         bt = bins_T if bins_T is not None else bins.T
         slot = jnp.zeros(bins.shape[0], jnp.int32)
-        return hist_pallas_q8(bt, quant.gq, quant.hq, quant.cq, slot, 1,
+        hq, ch = _q8_h_arg(quant)
+        return hist_pallas_q8(bt, quant.gq, hq, quant.cq, slot, 1,
                               num_bins, quant.scale_g, quant.scale_h,
-                              interpret=interp)[0]
+                              const_hess=ch, interpret=interp)[0]
     if quant is not None:
         # non-pallas backends: dequantize per row (same numbers the int32
         # accumulator would produce, up to f32 summation order)
         g = quant.gq.astype(jnp.float32) * (quant.scale_g / 127.0)
-        h = quant.hq.astype(jnp.float32) * (quant.scale_h / 127.0)
+        h = (quant.hq if quant.hq is not None else quant.cq).astype(
+            jnp.float32) * (quant.scale_h / 127.0)
         c = quant.cq.astype(jnp.float32)
     if impl == "scatter":
         return hist_leaf_scatter(bins, g, h, c, num_bins)
@@ -432,7 +451,8 @@ def hist_routed(bins, g, h, c, leaf_id, tables, na_bin, num_slots, num_bins,
     impl = pick_impl(impl)
     if quant is not None and impl != "pallas":
         g = quant.gq.astype(jnp.float32) * (quant.scale_g / 127.0)
-        h = quant.hq.astype(jnp.float32) * (quant.scale_h / 127.0)
+        h = (quant.hq if quant.hq is not None else quant.cq).astype(
+            jnp.float32) * (quant.scale_h / 127.0)
         c = quant.cq.astype(jnp.float32)
     if impl == "scatter":
         return hist_routed_scatter(bins, g, h, c, leaf_id, tables, na_bin,
@@ -447,10 +467,11 @@ def hist_routed(bins, g, h, c, leaf_id, tables, na_bin, num_slots, num_bins,
             # (one bins read per level instead of two, no [N] slot
             # round-trip; measured 8.3 ms/level for the separate route pass
             # at 10M rows)
+            hq, ch = _q8_h_arg(quant)
             return hist_routed_fused_q8(
-                bt, quant.gq, quant.hq, quant.cq, leaf_id, tables, na_bin,
+                bt, quant.gq, hq, quant.cq, leaf_id, tables, na_bin,
                 num_slots, num_bins, quant.scale_g, quant.scale_h,
-                tables.feat.shape[0], interpret=interp)
+                tables.feat.shape[0], const_hess=ch, interpret=interp)
         if bins.shape[1] <= 512:
             slot, lid2 = route_level_pallas(bt, leaf_id, tables, na_bin,
                                             num_slots, tables.feat.shape[0],
@@ -461,9 +482,11 @@ def hist_routed(bins, g, h, c, leaf_id, tables, na_bin, num_slots, num_bins,
             # training-width under this cap for sparse-wide datasets)
             slot, lid2 = route_level(bins, leaf_id, tables, na_bin, num_slots)
         if quant is not None:
-            return hist_pallas_q8(bt, quant.gq, quant.hq, quant.cq, slot,
+            hq, ch = _q8_h_arg(quant)
+            return hist_pallas_q8(bt, quant.gq, hq, quant.cq, slot,
                                   num_slots, num_bins, quant.scale_g,
-                                  quant.scale_h, interpret=interp), lid2
+                                  quant.scale_h, const_hess=ch,
+                                  interpret=interp), lid2
         return hist_pallas(bt, g, h, c, slot, num_slots, num_bins,
                            interpret=interp), lid2
     return hist_routed_onehot(bins, g, h, c, leaf_id, tables, na_bin,

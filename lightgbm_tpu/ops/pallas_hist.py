@@ -177,12 +177,13 @@ def hist_leaf_pallas(bins_T, g, h, c, num_bins: int,
 # ---------------------------------------------------------------------------
 
 def _kernel_q8(bins_ref, gq_ref, hq_ref, c_ref, slot_ref, out_ref, *,
-               fg: int, b: int, s: int, chunk: int):
+               fg: int, b: int, s: int, chunk: int, nch: int = 3):
     """One (feature-group j, row-chunk i) grid step, int8 x int8 -> int32.
 
     bins_ref: [Fg, C] uint8; gq/hq/c_ref: [C] int8; slot_ref: [C] i32;
-    out_ref: [Fg*B, S*3] i32 accumulated across i.
-    """
+    out_ref: [Fg*B, S*nch] i32 accumulated across i. nch=2 is the
+    constant-hessian variant (channels (gq, count); hq_ref unused — the
+    hessian histogram is count * scale_h/127, reconstructed by the caller)."""
     i = pl.program_id(1)
 
     @pl.when(i == 0)
@@ -197,37 +198,46 @@ def _kernel_q8(bins_ref, gq_ref, hq_ref, c_ref, slot_ref, out_ref, *,
     iota_b = jax.lax.broadcasted_iota(jnp.int32, (fg, b, chunk), 1)
     onehot = (bb == iota_b).astype(jnp.int8).reshape(fg * b, chunk)
 
-    # weights [S*3, C] int8: (gq, hq, count) broadcast to slot groups, masked
-    # by the row's slot (mask arithmetic in int32 — Mosaic's narrow-bitwidth
-    # select support is spotty; the final cast to int8 is exact)
+    # weights [S*nch, C] int8: (gq[, hq], count) broadcast to slot groups,
+    # masked by the row's slot (mask arithmetic in int32 — Mosaic's
+    # narrow-bitwidth select support is spotty; the final cast to int8 is
+    # exact)
     g = gq_ref[:].reshape(1, chunk).astype(jnp.int32)
-    h = hq_ref[:].reshape(1, chunk).astype(jnp.int32)
     c = c_ref[:].reshape(1, chunk).astype(jnp.int32)
-    ghc = jnp.concatenate([g, h, c], axis=0)                    # [3, C] i32
-    w = jax.lax.broadcast_in_dim(ghc, (s, 3, chunk), (1, 2)) \
-        .reshape(s * 3, chunk)                                  # [S*3, C]
+    if nch == 3:
+        h = hq_ref[:].reshape(1, chunk).astype(jnp.int32)
+        ghc = jnp.concatenate([g, h, c], axis=0)                # [3, C] i32
+    else:
+        ghc = jnp.concatenate([g, c], axis=0)                   # [2, C] i32
+    w = jax.lax.broadcast_in_dim(ghc, (s, nch, chunk), (1, 2)) \
+        .reshape(s * nch, chunk)                                # [S*nch, C]
     slot = slot_ref[:].reshape(1, chunk)
-    slot_of_row = jax.lax.broadcasted_iota(jnp.int32, (s * 3, chunk), 0) // 3
+    slot_of_row = jax.lax.broadcasted_iota(
+        jnp.int32, (s * nch, chunk), 0) // nch
     w = jnp.where(slot == slot_of_row, w, 0).astype(jnp.int8)
 
     part = jax.lax.dot_general(
         onehot, w, dimension_numbers=(((1,), (1,)), ((), ())),
-        preferred_element_type=jnp.int32)                       # [Fg*B, S*3]
+        preferred_element_type=jnp.int32)                       # [Fg*B, S*nch]
     out_ref[:] += part
 
 
 def hist_pallas_q8(bins_T: jnp.ndarray, gq: jnp.ndarray, hq: jnp.ndarray,
                    cq: jnp.ndarray, slot: jnp.ndarray, num_slots: int,
                    num_bins: int, scale_g, scale_h, chunk: int = _CHUNK_Q8,
+                   const_hess: bool = False,
                    interpret: bool = False) -> jnp.ndarray:
     """Slot-routed histogram from int8-quantized channels.
 
     gq/hq: [N] int8 (stochastic-rounded, see ops/histogram.py quantize_sr);
     cq: [N] int8 0/1 bag mask; scale_g/scale_h: the quantization scales
     (traced f32 scalars). Returns [S, 3, F, B] f32 with grad/hess channels
-    dequantized (count channel is exact)."""
+    dequantized (count channel is exact). const_hess drops the in-kernel
+    hessian channel (2-channel MXU contraction) and reconstructs it as
+    count * scale_h/127 — exact for h = h_const * bag01 rows."""
     f, n = bins_T.shape
     b, s = num_bins, num_slots
+    nch = 2 if const_hess else 3
 
     fg = max(1, min(f, _ACC_ROWS_MAX // b))
     n_fg = -(-f // fg)
@@ -243,7 +253,8 @@ def hist_pallas_q8(bins_T: jnp.ndarray, gq: jnp.ndarray, hq: jnp.ndarray,
     slot = jnp.minimum(slot, s)
     n_chunks = bins_T.shape[1] // chunk
 
-    kern = functools.partial(_kernel_q8, fg=fg, b=b, s=s, chunk=chunk)
+    kern = functools.partial(_kernel_q8, fg=fg, b=b, s=s, chunk=chunk,
+                             nch=nch)
     out = pl.pallas_call(
         kern,
         grid=(n_fg, n_chunks),
@@ -259,26 +270,31 @@ def hist_pallas_q8(bins_T: jnp.ndarray, gq: jnp.ndarray, hq: jnp.ndarray,
             pl.BlockSpec((chunk,), lambda j, i: (i,),
                          memory_space=pltpu.VMEM),
         ],
-        out_specs=pl.BlockSpec((fg * b, s * 3), lambda j, i: (j, 0),
+        out_specs=pl.BlockSpec((fg * b, s * nch), lambda j, i: (j, 0),
                                memory_space=pltpu.VMEM),
-        out_shape=jax.ShapeDtypeStruct((f_pad * b, s * 3), jnp.int32),
+        out_shape=jax.ShapeDtypeStruct((f_pad * b, s * nch), jnp.int32),
         cost_estimate=pl.CostEstimate(
-            flops=2 * n * f_pad * b * s * 3,
-            bytes_accessed=n * (f_pad + 7) + f_pad * b * s * 12,
+            flops=2 * n * f_pad * b * s * nch,
+            bytes_accessed=n * (f_pad + 7) + f_pad * b * s * 4 * nch,
             transcendentals=0),
         interpret=interpret,
     )(bins_T, gq, hq, cq, slot)
 
-    out = out.reshape(f_pad, b, s, 3).astype(jnp.float32)
+    out = out.reshape(f_pad, b, s, nch).astype(jnp.float32)
     sg = scale_g * jnp.float32(1.0 / 127.0)
     sh = scale_h * jnp.float32(1.0 / 127.0)
-    hist = jnp.stack([out[..., 0] * sg, out[..., 1] * sh, out[..., 2]],
-                     axis=-1).transpose(2, 3, 0, 1)
+    if const_hess:
+        cnt = out[..., 1]
+        hist = jnp.stack([out[..., 0] * sg, cnt * sh, cnt],
+                         axis=-1).transpose(2, 3, 0, 1)
+    else:
+        hist = jnp.stack([out[..., 0] * sg, out[..., 1] * sh, out[..., 2]],
+                         axis=-1).transpose(2, 3, 0, 1)
     return hist[:, :, :f, :]
 
 
 def _kernel_q8_fused(*refs, f: int, b: int, s: int, l: int, chunk: int,
-                     has_cat: bool):
+                     has_cat: bool, nch: int = 3):
     """Fused route + int8 histogram for ONE feature group (F*B <= block cap).
 
     Per level the two-pass scheme reads the bin matrix twice (route kernel,
@@ -349,12 +365,16 @@ def _kernel_q8_fused(*refs, f: int, b: int, s: int, l: int, chunk: int,
     iota_b = jax.lax.broadcasted_iota(jnp.int32, (f, b, chunk), 1)
     onehot = (bb == iota_b).astype(jnp.int8).reshape(f * b, chunk)
     g = gq_ref[:].reshape(1, chunk).astype(jnp.int32)
-    h = hq_ref[:].reshape(1, chunk).astype(jnp.int32)
     c = cq_ref[:].reshape(1, chunk).astype(jnp.int32)
-    ghc = jnp.concatenate([g, h, c], axis=0)
-    w = jax.lax.broadcast_in_dim(ghc, (s, 3, chunk), (1, 2)) \
-        .reshape(s * 3, chunk)
-    slot_of_row = jax.lax.broadcasted_iota(jnp.int32, (s * 3, chunk), 0) // 3
+    if nch == 3:
+        h = hq_ref[:].reshape(1, chunk).astype(jnp.int32)
+        ghc = jnp.concatenate([g, h, c], axis=0)
+    else:   # constant hessian: (gq, count) only
+        ghc = jnp.concatenate([g, c], axis=0)
+    w = jax.lax.broadcast_in_dim(ghc, (s, nch, chunk), (1, 2)) \
+        .reshape(s * nch, chunk)
+    slot_of_row = jax.lax.broadcasted_iota(
+        jnp.int32, (s * nch, chunk), 0) // nch
     w = jnp.where(slot == slot_of_row, w, 0).astype(jnp.int8)
     part = jax.lax.dot_general(
         onehot, w, dimension_numbers=(((1,), (1,)), ((), ())),
@@ -365,19 +385,22 @@ def _kernel_q8_fused(*refs, f: int, b: int, s: int, l: int, chunk: int,
 def hist_routed_fused_q8(bins_T, gq, hq, cq, leaf_id, tables, na_bin,
                          num_slots: int, num_bins: int, scale_g, scale_h,
                          num_leaves: int, chunk: int = 0,
+                         const_hess: bool = False,
                          interpret: bool = False):
     """Fused route+histogram level pass. Returns ([S, 3, F, B] f32, lid2 [N]).
 
     Only valid when every feature fits one accumulator block
-    (F * num_bins <= _ACC_ROWS_MAX) — the router must see ALL columns."""
+    (F * num_bins <= _ACC_ROWS_MAX) — the router must see ALL columns.
+    const_hess: see hist_pallas_q8."""
     f, n = bins_T.shape
     b, s, l = num_bins, num_slots, num_leaves
+    nch = 2 if const_hess else 3
     assert f * b <= _ACC_ROWS_MAX
     if chunk == 0:
         # doubled chunk halves per-chunk fixed costs; at deep S the
-        # [S*3, C] weights + [FB, C] onehot + route blocks near the 16MB
+        # [S*nch, C] weights + [FB, C] onehot + route blocks near the 16MB
         # VMEM ceiling, so fall back to 2048
-        chunk = 4096 if s * 3 <= 192 else _CHUNK_Q8
+        chunk = 4096 if s * nch <= 192 else _CHUNK_Q8
 
     has_cat = tables.is_cat is not None
     iscat_row = (tables.is_cat.astype(jnp.float32) if has_cat
@@ -414,32 +437,37 @@ def hist_routed_fused_q8(bins_T, gq, hq, cq, leaf_id, tables, na_bin,
         args.append(tables.member.astype(jnp.float32).T)
 
     kern = functools.partial(_kernel_q8_fused, f=f, b=b, s=s, l=l,
-                             chunk=chunk, has_cat=has_cat)
+                             chunk=chunk, has_cat=has_cat, nch=nch)
     out, lid2 = pl.pallas_call(
         kern,
         grid=(n_chunks,),
         in_specs=in_specs,
         out_specs=(
-            pl.BlockSpec((f * b, s * 3), lambda i: (0, 0),
+            pl.BlockSpec((f * b, s * nch), lambda i: (0, 0),
                          memory_space=pltpu.VMEM),
             pl.BlockSpec((chunk,), lambda i: (i,), memory_space=pltpu.VMEM),
         ),
         out_shape=(
-            jax.ShapeDtypeStruct((f * b, s * 3), jnp.int32),
+            jax.ShapeDtypeStruct((f * b, s * nch), jnp.int32),
             jax.ShapeDtypeStruct((bins_Tp.shape[1],), jnp.int32),
         ),
         cost_estimate=pl.CostEstimate(
-            flops=2 * n * f * b * s * 3 + 2 * n * l * 9,
-            bytes_accessed=n * (f + 11) + f * b * s * 12,
+            flops=2 * n * f * b * s * nch + 2 * n * l * 9,
+            bytes_accessed=n * (f + 11) + f * b * s * 4 * nch,
             transcendentals=0),
         interpret=interpret,
     )(*args)
 
-    out = out.reshape(f, b, s, 3).astype(jnp.float32)
+    out = out.reshape(f, b, s, nch).astype(jnp.float32)
     sg = scale_g * jnp.float32(1.0 / 127.0)
     sh = scale_h * jnp.float32(1.0 / 127.0)
-    hist = jnp.stack([out[..., 0] * sg, out[..., 1] * sh, out[..., 2]],
-                     axis=-1).transpose(2, 3, 0, 1)
+    if const_hess:
+        cnt = out[..., 1]
+        hist = jnp.stack([out[..., 0] * sg, cnt * sh, cnt],
+                         axis=-1).transpose(2, 3, 0, 1)
+    else:
+        hist = jnp.stack([out[..., 0] * sg, out[..., 1] * sh, out[..., 2]],
+                         axis=-1).transpose(2, 3, 0, 1)
     return hist, lid2[:n]
 
 

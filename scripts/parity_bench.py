@@ -113,13 +113,11 @@ def train_tpu(X, y, Xv, yv, leaves, bins, iters, lr):
     booster = lgb.Booster(params=params, train_set=ds)
     t0 = time.time()
     for it in range(iters):
+        # no explicit per-K sync: the trainer bounds its own in-flight
+        # dispatch queue (gbdt.py _grow_and_update syncs every 20th iter);
+        # an extra block every 10 iters measured ~130 ms/iter of pipeline
+        # stall at 1M rows — 4x the device cost of one iteration
         booster.update()
-        if (it + 1) % 10 == 0:
-            # 10, not 50: ~50 queued iterations (hundreds of in-flight
-            # programs) reproducibly crash the tunneled TPU worker
-            # bound the async dispatch queue: hundreds of in-flight tree
-            # programs through the tunneled runtime can crash the worker
-            jax.block_until_ready(booster.raw_train_score())
         if (it + 1) % 100 == 0:
             print(f"  iter {it + 1}/{iters} t={time.time() - t0:.1f}s",
                   file=sys.stderr, flush=True)

@@ -63,6 +63,47 @@ def main():
     np.testing.assert_allclose(outq, refq, rtol=0, atol=0.5)
     print("hist_pallas_q8 OK")
 
+    # ---- constant-hessian elision: 2-channel kernel must equal the
+    # 3-channel kernel run with hq = cq (the exact quantization of a
+    # constant hessian; GrowParams.const_hess docstring) ----
+    h_const = 0.37
+    out3 = np.asarray(hist_pallas_q8(
+        jnp.asarray(bins.T.copy()), jnp.asarray(gq), jnp.asarray(cq),
+        jnp.asarray(cq), jnp.asarray(slot), s, b,
+        jnp.float32(127.0), jnp.float32(127.0 * h_const)))
+    out2 = np.asarray(hist_pallas_q8(
+        jnp.asarray(bins.T.copy()), jnp.asarray(gq), jnp.asarray(cq),
+        jnp.asarray(cq), jnp.asarray(slot), s, b,
+        jnp.float32(127.0), jnp.float32(127.0 * h_const), const_hess=True))
+    np.testing.assert_allclose(out2, out3, rtol=1e-6, atol=1e-4)
+    print("hist_pallas_q8 const_hess OK")
+
+    # same for the fused route+hist kernel
+    from lightgbm_tpu.ops.pallas_hist import hist_routed_fused_q8
+    L0, S0 = 8, 4
+    tabs0 = H.RouteTables(
+        feat=jnp.asarray(np.array([0, -1, 2, 4, 1, -1, 3, 0], np.int32)),
+        thr=jnp.asarray(rng.randint(0, b, size=L0).astype(np.int32)),
+        dleft=jnp.asarray(rng.randint(0, 2, size=L0).astype(np.int32)),
+        new_leaf=jnp.asarray((np.arange(L0) + L0).astype(np.int32)),
+        slot_left=jnp.asarray(rng.randint(0, S0 + 1, size=L0).astype(np.int32)),
+        slot_right=jnp.asarray(rng.randint(0, S0 + 1, size=L0).astype(np.int32)))
+    lid0 = jnp.asarray(rng.randint(0, L0, size=n).astype(np.int32))
+    nab0 = jnp.full(f, 256, jnp.int32)
+    f3, l3 = hist_routed_fused_q8(
+        jnp.asarray(bins.T.copy()), jnp.asarray(gq), jnp.asarray(cq),
+        jnp.asarray(cq), lid0, tabs0, nab0, S0, b,
+        jnp.float32(127.0), jnp.float32(127.0 * h_const), L0)
+    f2_, l2_ = hist_routed_fused_q8(
+        jnp.asarray(bins.T.copy()), jnp.asarray(gq), jnp.asarray(cq),
+        jnp.asarray(cq), lid0, tabs0, nab0, S0, b,
+        jnp.float32(127.0), jnp.float32(127.0 * h_const), L0,
+        const_hess=True)
+    np.testing.assert_allclose(np.asarray(f2_), np.asarray(f3),
+                               rtol=1e-6, atol=1e-4)
+    np.testing.assert_array_equal(np.asarray(l2_), np.asarray(l3))
+    print("hist_routed_fused_q8 const_hess OK")
+
     # ---- fused route pass vs XLA reference ----
     L, S = 8, 4
     n2, f2, b2 = 30000, 5, 16
