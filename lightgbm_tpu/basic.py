@@ -638,6 +638,52 @@ class Dataset:
     def feature_names(self) -> List[str]:
         return list(self._names)
 
+    def add_features_from(self, other: "Dataset") -> "Dataset":
+        """Append ``other``'s features to this Dataset (reference:
+        Dataset::AddFeaturesFrom, src/io/dataset.cpp:1385, exposed as
+        Dataset.add_features_from, python-package basic.py:1625).
+
+        Both Datasets must be constructed and hold the same number of rows;
+        labels/weights/groups stay this Dataset's. The binned device matrices
+        are concatenated column-wise and the bin/bundle metadata merged, so
+        the result trains exactly like a dataset constructed from the
+        horizontally-stacked raw data (modulo each side's own EFB plan)."""
+        if not self._constructed or not other._constructed:
+            log.fatal("Both source and target Datasets must be constructed "
+                      "before adding features")
+        if other._num_data != self._num_data:
+            log.fatal("Cannot add features from other Dataset with a "
+                      "different number of rows")
+        if self.bundle_meta is not None or other.bundle_meta is not None:
+            from .efb import identity_meta, merge_bundle_meta
+            a = self.bundle_meta or identity_meta(self.mappers)
+            b = other.bundle_meta or identity_meta(other.mappers)
+            self.bundle_meta = merge_bundle_meta(a, b, len(self.mappers))
+        fm_a = (self.feature_map if self.feature_map is not None
+                else np.arange(len(self.mappers), dtype=np.int64))
+        fm_b = (other.feature_map if other.feature_map is not None
+                else np.arange(len(other.mappers), dtype=np.int64))
+        self.feature_map = np.concatenate(
+            [np.asarray(fm_a, dtype=np.int64),
+             np.asarray(fm_b, dtype=np.int64) + int(self._num_features_raw)])
+        self.mappers = list(self.mappers) + list(other.mappers)
+        self.bins = jnp.concatenate([self.bins, other.bins], axis=1)
+        self._num_bins_np = np.concatenate([self._num_bins_np,
+                                            other._num_bins_np])
+        self._na_bin_raw = np.concatenate([np.asarray(self._na_bin_raw),
+                                           np.asarray(other._na_bin_raw)])
+        self._mtypes_np = np.concatenate([self._mtypes_np, other._mtypes_np])
+        self.num_bins_dev = jax.device_put(self._num_bins_np)
+        self.na_bin_dev = jax.device_put(
+            np.where(self._na_bin_raw < 0, 255 + 1,
+                     self._na_bin_raw).astype(np.int32))
+        self.missing_type_dev = jax.device_put(self._mtypes_np)
+        self.max_num_bins = max(self.max_num_bins, other.max_num_bins)
+        self._names = list(self._names) + list(other._names)
+        self._num_features_raw = (int(self._num_features_raw or 0)
+                                  + int(other._num_features_raw or 0))
+        return self
+
 
 class Booster:
     """Trained/training model handle (reference: lightgbm.Booster, basic.py:1666)."""
@@ -655,6 +701,10 @@ class Booster:
         self.best_score: Dict[str, Dict[str, float]] = {}
         self.train_set = None
         self.name_valid_sets: List[str] = []
+        # free-form string attributes (reference: Booster.attr/set_attr,
+        # python-package basic.py:2845 — a pure in-memory dict, copied on
+        # refit, never serialized into the model file)
+        self._attr: Dict[str, str] = {}
 
         if model_file is not None:
             with open(model_file) as f:
@@ -729,7 +779,8 @@ class Booster:
 
     @property
     def current_iteration(self) -> int:
-        return self._gbdt.iter_ if self._gbdt else len(self.trees) // max(self.num_model_per_iteration, 1)
+        return (self._gbdt.iter_ if self._gbdt
+                else len(self.trees) // max(self.num_model_per_iteration(), 1))
 
     def num_model_per_iteration(self) -> int:
         if self._gbdt:
@@ -765,13 +816,39 @@ class Booster:
 
     def predict(self, data, num_iteration: Optional[int] = None,
                 raw_score: bool = False, pred_leaf: bool = False,
-                pred_contrib: bool = False, **kwargs):
+                pred_contrib: bool = False, data_has_header: bool = False,
+                **kwargs):
         """Batch prediction on raw features (reference: Booster.predict ->
         Predictor, predictor.hpp:29).
+
+        ``data`` may be a file path (reference: Predictor::Predict on a data
+        file, c_api LGBM_BoosterPredictForFile): the file is parsed with the
+        usual CSV/TSV/LibSVM sniffing, and — as in the reference parser
+        factory — a leading label column is assumed present only when the
+        column count exceeds the model's feature count.
 
         Returns an ndarray, EXCEPT for scipy-sparse input with
         ``pred_contrib=True`` which returns a scipy sparse matrix (reference
         parity: sparse in -> sparse contribs out, c_api.h:747)."""
+        import os as _os
+        if isinstance(data, (str, _os.PathLike)):
+            from .io.parser import detect_format, load_file
+            kind, _ = detect_format(str(data), skip_header=data_has_header)
+            pf = load_file(str(data), header=data_has_header,
+                           num_features_hint=self.num_feature())
+            x = pf.X
+            nf = self.num_feature()
+            if (kind != "libsvm" and pf.label is not None and nf
+                    and x.shape[1] < nf):
+                # the parser stripped column 0 as a label by default, but the
+                # column count does not EXCEED the model width, so no label
+                # is assumed (reference parser-factory rule) — restore it.
+                # A still-too-narrow file then fails the width check below
+                # honestly instead of silently shifting features. (LibSVM
+                # labels are never positional feature columns, so the restore
+                # must not fire there even when trailing features are absent.)
+                x = np.column_stack([pf.label, x])
+            data = x
         if _is_scipy_sparse(data):
             # chunked densify: bounded [chunk, F] f64 intermediates instead of
             # the full dense matrix (reference predicts straight off CSR,
@@ -932,6 +1009,7 @@ class Booster:
             else:
                 score[:, cls] += delta
         new_b._pseudo_router = None
+        new_b._attr = dict(self._attr)   # reference: refit copies __attr
         return new_b
 
     def save_model(self, filename: str, num_iteration: Optional[int] = None,
@@ -993,3 +1071,103 @@ class Booster:
         if self.train_set is not None:
             return self.train_set.num_feature()
         return int(self._loaded_meta.get("max_feature_idx", -1)) + 1
+
+    # ---- conveniences (reference python-package Booster surface) ----
+    def attr(self, key: str) -> Optional[str]:
+        """Get a string attribute, or None (reference: Booster.attr,
+        basic.py:2845)."""
+        return self._attr.get(key)
+
+    def set_attr(self, **kwargs) -> "Booster":
+        """Set string attributes; a value of None deletes the key
+        (reference: Booster.set_attr, basic.py:2861)."""
+        for key, value in kwargs.items():
+            if value is None:
+                self._attr.pop(key, None)
+            else:
+                if not isinstance(value, str):
+                    raise ValueError("Only string values are accepted")
+                self._attr[key] = value
+        return self
+
+    def get_leaf_output(self, tree_id: int, leaf_id: int) -> float:
+        """Output value of one leaf (reference: Booster.get_leaf_output ->
+        LGBM_BoosterGetLeafValue, basic.py:2591 / c_api.cpp)."""
+        trees = self._ensure_host_trees()
+        if not 0 <= tree_id < len(trees):
+            log.fatal(f"tree_id {tree_id} out of range [0, {len(trees)})")
+        t = trees[tree_id]
+        if not 0 <= leaf_id < t.num_leaves:
+            log.fatal(f"leaf_id {leaf_id} out of range [0, {t.num_leaves})")
+        return float(t.leaf_value[leaf_id])
+
+    def get_split_value_histogram(self, feature, bins=None,
+                                  xgboost_style: bool = False):
+        """Histogram of the split thresholds used for one feature
+        (reference: Booster.get_split_value_histogram, basic.py:2693).
+
+        The reference recurses over the JSON dump; here the flat tree arrays
+        are scanned directly. The bin-count selection rules (None -> number
+        of unique thresholds; int + xgboost_style -> capped at that count)
+        are the documented API contract and match the reference."""
+        names = self.feature_name()
+        if isinstance(feature, str):
+            if feature not in names:
+                log.fatal(f"Unknown feature name {feature!r}")
+            fidx = names.index(feature)
+        else:
+            fidx = int(feature)
+        values: List[float] = []
+        for t in self._ensure_host_trees():
+            for i in range(t.num_leaves - 1):
+                if int(t.split_feature[i]) != fidx:
+                    continue
+                if bool(t.is_cat_node[i]):
+                    log.fatal("Cannot compute split value histogram for the "
+                              "categorical feature")
+                values.append(float(t.threshold_real[i]))
+        if bins is None or (isinstance(bins, (int, np.integer))
+                            and xgboost_style):
+            n_unique = len(np.unique(values))
+            bins = max(min(n_unique, bins) if bins is not None else n_unique, 1)
+        hist, edges = np.histogram(values, bins=bins)
+        if xgboost_style:
+            ret = np.column_stack((edges[1:], hist))
+            ret = ret[ret[:, 1] > 0]
+            if _PANDAS:
+                return pd.DataFrame(ret, columns=["SplitValue", "Count"])
+            return ret
+        return hist, edges
+
+    def shuffle_models(self, start_iteration: int = 0,
+                       end_iteration: int = -1) -> "Booster":
+        """Randomly permute the iteration order of the ensemble (reference:
+        Booster.shuffle_models -> GBDT::ShuffleModels, gbdt.h:79: shuffles
+        whole iterations — blocks of num_model_per_iteration trees — in
+        [start_iteration, end_iteration), seeded deterministically)."""
+        trees = self._ensure_host_trees()
+        k = max(self.num_model_per_iteration(), 1)
+        total_iter = len(trees) // k
+        start = max(0, start_iteration)
+        end = total_iter if end_iteration <= 0 else min(total_iter,
+                                                        end_iteration)
+        perm = np.arange(total_iter)
+        if end > start:
+            rng = np.random.RandomState(17)
+            sub = perm[start:end].copy()
+            rng.shuffle(sub)
+            perm[start:end] = sub
+
+        def _reorder(lst):
+            return [lst[it * k + j] for it in perm for j in range(k)]
+
+        if self._gbdt is not None:
+            # keep the device-side model list consistent with the host list
+            # so continued training / device prediction see the same order
+            self._gbdt.models_host = _reorder(self._gbdt.models_host)
+            self._gbdt.models_dev = _reorder(self._gbdt.models_dev)
+            self.trees = self._gbdt.models_host
+        else:
+            self.trees = _reorder(trees)
+        self._pseudo_router = None   # predict caches tree order
+        return self

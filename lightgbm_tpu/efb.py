@@ -228,6 +228,59 @@ def plan_bundles(bins: np.ndarray, mappers: List[BinMapper],
     return meta
 
 
+def identity_meta(mappers: List[BinMapper]) -> BundleMeta:
+    """Trivial plan mapping every used feature to its own column.
+
+    Used by ``Dataset.add_features_from`` when one side of the merge was
+    bundled and the other was not: the unbundled side gets this identity
+    plan so the two plans concatenate uniformly.
+    """
+    f = len(mappers)
+    B = 256
+    pos_feat = np.zeros((f, B), dtype=np.int32)
+    pos_bin = np.tile(np.arange(B, dtype=np.int32), (f, 1))
+    range_start = np.zeros((f, B), dtype=np.int32)
+    range_end = np.zeros((f, B), dtype=np.int32)
+    prefix_end = np.zeros((f, B), dtype=np.int32)
+    incl_default = np.zeros((f, B), dtype=bool)
+    valid = np.zeros((f, B), dtype=bool)   # singles use the numerical scan
+    num_bins = np.zeros(f, dtype=np.int32)
+    columns: List[List[Tuple[int, int, int]]] = []
+    for j, m in enumerate(mappers):
+        nb = m.num_bins
+        columns.append([(j, 0, nb)])
+        pos_feat[j, :] = j
+        range_end[j, :] = nb - 1
+        num_bins[j] = nb
+    return BundleMeta(members=columns,
+                      default_bin=np.zeros(f, dtype=np.int32),
+                      pos_feat=pos_feat, pos_bin=pos_bin,
+                      range_start=range_start, range_end=range_end,
+                      prefix_end=prefix_end, incl_default=incl_default,
+                      valid=valid, is_bundle=np.zeros(f, dtype=bool),
+                      num_bins=num_bins)
+
+
+def merge_bundle_meta(a: BundleMeta, b: BundleMeta, n_used_a: int) -> BundleMeta:
+    """Concatenate two bundle plans; ``b``'s member feature ids shift by
+    ``n_used_a`` (the first dataset's used-feature count). Analog of the
+    feature-group append in Dataset::AddFeaturesFrom (dataset.cpp:1385)."""
+    members = a.members + [[(j + n_used_a, off, nb) for j, off, nb in mem]
+                           for mem in b.members]
+    return BundleMeta(
+        members=members,
+        default_bin=np.concatenate([a.default_bin, b.default_bin]),
+        pos_feat=np.vstack([a.pos_feat, b.pos_feat + n_used_a]),
+        pos_bin=np.vstack([a.pos_bin, b.pos_bin]),
+        range_start=np.vstack([a.range_start, b.range_start]),
+        range_end=np.vstack([a.range_end, b.range_end]),
+        prefix_end=np.vstack([a.prefix_end, b.prefix_end]),
+        incl_default=np.vstack([a.incl_default, b.incl_default]),
+        valid=np.vstack([a.valid, b.valid]),
+        is_bundle=np.concatenate([a.is_bundle, b.is_bundle]),
+        num_bins=np.concatenate([a.num_bins, b.num_bins]))
+
+
 def apply_bundles(bins: np.ndarray, meta: BundleMeta) -> np.ndarray:
     """Build the bundled uint8 matrix from the original binned matrix
     (FastFeatureBundling / FeatureGroup::bin_offsets analog)."""

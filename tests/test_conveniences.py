@@ -1,0 +1,230 @@
+"""Python-package convenience surface (VERDICT r4 missing #2).
+
+Mirrors the reference's usage in tests/python_package_test/test_basic.py
+(add_features_from, attr/set_attr) and test_engine.py:1535
+(get_split_value_histogram shapes).
+"""
+import os
+
+import numpy as np
+import pytest
+
+import lightgbm_tpu as lgb
+from lightgbm_tpu import LightGBMError
+
+
+def _train(X, y, n_iter=10, **params):
+    p = {"objective": "regression", "num_leaves": 7, "min_data_in_leaf": 5,
+         "verbosity": -1, **params}
+    ds = lgb.Dataset(X, label=y, params=p)
+    b = lgb.Booster(params=p, train_set=ds)
+    for _ in range(n_iter):
+        b.update()
+    return b
+
+
+# ---- Dataset.add_features_from ----
+
+def test_add_features_throws_if_num_data_unequal():
+    d1 = lgb.Dataset(np.random.random((100, 1))).construct()
+    d2 = lgb.Dataset(np.random.random((10, 1))).construct()
+    with pytest.raises(LightGBMError):
+        d1.add_features_from(d2)
+
+
+def test_add_features_throws_if_datasets_unconstructed():
+    X1 = np.random.random((100, 1))
+    X2 = np.random.random((100, 1))
+    with pytest.raises(LightGBMError):
+        lgb.Dataset(X1).add_features_from(lgb.Dataset(X2))
+    with pytest.raises(LightGBMError):
+        lgb.Dataset(X1).construct().add_features_from(lgb.Dataset(X2))
+    with pytest.raises(LightGBMError):
+        lgb.Dataset(X1).add_features_from(lgb.Dataset(X2).construct())
+
+
+def test_add_features_same_booster_behaviour():
+    # reference: test_add_features_same_booster_behaviour — training on the
+    # merged dataset must equal training on the horizontally-stacked data
+    rng = np.random.RandomState(42)
+    X = rng.random_sample((200, 5))
+    y = rng.random_sample(200)
+    names = ["col_%d" % i for i in range(5)]
+    p = {"objective": "regression", "num_leaves": 7, "min_data_in_leaf": 5,
+         "verbosity": -1}
+    for j in range(1, 5):
+        d1 = lgb.Dataset(X[:, :j], label=y, feature_name=names[:j],
+                         params=p).construct()
+        d2 = lgb.Dataset(X[:, j:], feature_name=names[j:],
+                         params=p).construct()
+        d1.add_features_from(d2)
+        d = lgb.Dataset(X, label=y, feature_name=names, params=p).construct()
+        b1 = lgb.Booster(params=p, train_set=d1)
+        b = lgb.Booster(params=p, train_set=d)
+        for _ in range(10):
+            b.update()
+            b1.update()
+        assert b1.model_to_string() == b.model_to_string()
+
+
+def test_add_features_with_efb_side():
+    # one side sparse enough to bundle: merged training must still match
+    # stacked-data predictions (EFB is lossless at zero conflict rate)
+    rng = np.random.RandomState(7)
+    Xd = rng.random_sample((300, 3))
+    Xs = np.where(rng.random_sample((300, 4)) < 0.9, 0.0,
+                  rng.random_sample((300, 4)))
+    y = rng.random_sample(300)
+    p = {"objective": "regression", "num_leaves": 7, "min_data_in_leaf": 5,
+         "verbosity": -1, "enable_bundle": True}
+    d1 = lgb.Dataset(Xd, label=y, params=p).construct()
+    d2 = lgb.Dataset(Xs, params=p).construct()
+    d1.add_features_from(d2)
+    b1 = lgb.Booster(params=p, train_set=d1)
+    X = np.column_stack([Xd, Xs])
+    b = lgb.Booster(params=p, train_set=lgb.Dataset(X, label=y, params=p))
+    for _ in range(10):
+        b.update()
+        b1.update()
+    np.testing.assert_allclose(b1.predict(X), b.predict(X), rtol=1e-6)
+
+
+# ---- Booster.attr / set_attr ----
+
+def test_attr_set_attr_and_refit_copy():
+    rng = np.random.RandomState(0)
+    X, y = rng.random_sample((120, 4)), rng.random_sample(120)
+    b = _train(X, y)
+    assert b.attr("k") is None
+    b.set_attr(k="v", other="x")
+    assert b.attr("k") == "v"
+    b.set_attr(other=None)          # None deletes
+    assert b.attr("other") is None
+    with pytest.raises(ValueError):
+        b.set_attr(bad=3)           # only strings accepted
+    nb = b.refit(X, y)
+    assert nb.attr("k") == "v"      # reference: refit copies __attr
+
+
+# ---- Booster.get_leaf_output ----
+
+def test_get_leaf_output_matches_prediction():
+    rng = np.random.RandomState(1)
+    X, y = rng.random_sample((150, 4)), rng.random_sample(150)
+    b = _train(X, y, n_iter=3)
+    leaves = b.predict(X, pred_leaf=True)       # [N, T]
+    raw = b.predict(X, raw_score=True)
+    recon = np.zeros(len(X))
+    for t in range(leaves.shape[1]):
+        recon += [b.get_leaf_output(t, int(l)) for l in leaves[:, t]]
+    np.testing.assert_allclose(recon, raw, rtol=1e-5)
+    with pytest.raises(LightGBMError):
+        b.get_leaf_output(10_000, 0)
+    with pytest.raises(LightGBMError):
+        b.get_leaf_output(0, 10_000)
+
+
+# ---- Booster.get_split_value_histogram ----
+
+def test_get_split_value_histogram_shapes():
+    # reference: test_engine.py:1535 — xgboost_style shape rules
+    rng = np.random.RandomState(2)
+    X, y = rng.random_sample((200, 3)), rng.random_sample(200)
+    b = _train(X, y, n_iter=20, num_leaves=15)
+    hist, edges = b.get_split_value_histogram(0)
+    assert len(edges) == len(hist) + 1
+    n_unique = len(hist[hist > 0]) if hist.sum() else 0
+    # bins=None -> number of unique split values
+    thr = [float(t.threshold_real[i])
+           for t in b._ensure_host_trees()
+           for i in range(t.num_leaves - 1) if t.split_feature[i] == 0]
+    assert len(hist) == max(len(np.unique(thr)), 1)
+    # xgboost_style: rows are non-empty bins only; bins caps at n_unique
+    res = b.get_split_value_histogram(0, xgboost_style=True)
+    arr = res.values if hasattr(res, "values") else res
+    assert arr.shape[1] == 2
+    assert (arr[:, 1] > 0).all()
+    small = b.get_split_value_histogram(0, bins=1, xgboost_style=True)
+    sarr = small.values if hasattr(small, "values") else small
+    assert sarr.shape == (1, 2)
+    # by-name equals by-index
+    name = b.feature_name()[0]
+    res2 = b.get_split_value_histogram(name, xgboost_style=True)
+    arr2 = res2.values if hasattr(res2, "values") else res2
+    np.testing.assert_array_equal(np.asarray(arr), np.asarray(arr2))
+
+
+def test_get_split_value_histogram_categorical_raises():
+    rng = np.random.RandomState(3)
+    X = np.column_stack([rng.randint(0, 5, 300).astype(float),
+                         rng.random_sample(300)])
+    y = X[:, 0] * 0.5 + rng.random_sample(300)
+    p = {"objective": "regression", "num_leaves": 7, "min_data_in_leaf": 5,
+         "verbosity": -1, "min_data_per_group": 1, "cat_smooth": 1.0}
+    ds = lgb.Dataset(X, label=y, categorical_feature=[0], params=p)
+    b = lgb.Booster(params=p, train_set=ds)
+    for _ in range(10):
+        b.update()
+    used = {int(f) for t in b._ensure_host_trees()
+            for f in t.split_feature[: t.num_leaves - 1]}
+    if 0 in used:
+        with pytest.raises(LightGBMError):
+            b.get_split_value_histogram(0)
+
+
+# ---- Booster.shuffle_models ----
+
+def test_shuffle_models_preserves_sum_and_is_deterministic():
+    rng = np.random.RandomState(4)
+    X, y = rng.random_sample((150, 4)), rng.random_sample(150)
+    b = _train(X, y, n_iter=8)
+    before = b.predict(X)
+    order_before = [id(t) for t in b._ensure_host_trees()]
+    b.shuffle_models()
+    order_after = [id(t) for t in b._ensure_host_trees()]
+    assert order_before != order_after          # something moved
+    assert sorted(order_before) == sorted(order_after)
+    np.testing.assert_allclose(b.predict(X), before, rtol=1e-6)
+    # deterministic: same seed -> same permutation on an identical booster
+    b2 = _train(X, y, n_iter=8)
+    b2.shuffle_models()
+    assert b.model_to_string() == b2.model_to_string()
+    # range-limited shuffle leaves the prefix alone
+    b3 = _train(X, y, n_iter=8)
+    first = b3._ensure_host_trees()[0]
+    b3.shuffle_models(start_iteration=4)
+    assert b3._ensure_host_trees()[0] is first
+
+
+def test_shuffle_models_on_loaded_booster():
+    rng = np.random.RandomState(5)
+    X, y = rng.random_sample((150, 4)), rng.random_sample(150)
+    b = _train(X, y, n_iter=6)
+    lb = lgb.Booster(model_str=b.model_to_string())
+    before = lb.predict(X)
+    lb.shuffle_models()
+    np.testing.assert_allclose(lb.predict(X), before, rtol=1e-6)
+
+
+# ---- Booster.predict on a file path ----
+
+def test_predict_from_file_path(tmp_path):
+    rng = np.random.RandomState(6)
+    X, y = rng.random_sample((120, 4)), rng.random_sample(120)
+    b = _train(X, y)
+    expected = b.predict(X)
+    # with a leading label column (CLI-style data file)
+    with_label = os.path.join(str(tmp_path), "with_label.tsv")
+    np.savetxt(with_label, np.column_stack([y, X]), fmt="%.9g",
+               delimiter="\t")
+    np.testing.assert_allclose(b.predict(with_label), expected, rtol=1e-5)
+    # features only: column count == num_feature -> no label assumed
+    no_label = os.path.join(str(tmp_path), "no_label.tsv")
+    np.savetxt(no_label, X, fmt="%.9g", delimiter="\t")
+    np.testing.assert_allclose(b.predict(no_label), expected, rtol=1e-5)
+    # with a header row
+    hdr = os.path.join(str(tmp_path), "hdr.csv")
+    np.savetxt(hdr, np.column_stack([y, X]), fmt="%.9g", delimiter=",",
+               header="label,a,b,c,d", comments="")
+    np.testing.assert_allclose(b.predict(hdr, data_has_header=True),
+                               expected, rtol=1e-5)
