@@ -155,12 +155,12 @@ _PARAMS: Dict[str, Tuple[Any, Tuple[str, ...]]] = {
     # instead of 5 bf16 — ~3.3x on the dominant contraction; leaf values are
     # renewed from exact sums), "true"/"false" force it
     "use_quantized_grad": ("auto", ()),
-    # EXPERIMENTAL segment-packed depthwise levels (row compaction, the
-    # reference's DataPartition ordering). Off by default: measured 10-24x
-    # SLOWER end-to-end on the tunneled v5e runtime — the per-level
-    # permutation gathers/scatters dominate there despite the halved
-    # histogram work. Kept behind this flag (correctness is test-asserted)
-    # for re-evaluation on directly-attached TPU runtimes.
+    # RETIRED segment-packed depthwise levels (row compaction, the
+    # reference's DataPartition ordering): measured 10-24x SLOWER end-to-end
+    # on the tunneled v5e runtime — per-level permutation gathers/scatters
+    # dominate despite the halved histogram work. The implementation is
+    # archived on branch `archive/packed-levels`; the flag stays registered
+    # (accepted, warn-ignored) so old configs don't error.
     "packed_levels": (False, ()),
     # depthwise is the TPU default: O(depth) histogram passes per tree instead of
     # O(num_leaves) (the reference's leaf-wise semantics are available via

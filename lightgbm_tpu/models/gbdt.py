@@ -126,8 +126,6 @@ class GBDT:
                         incompat.append("forced splits")
                     if config.feature_fraction_bynode < 1.0:
                         incompat.append("feature_fraction_bynode")
-                    if str(config.packed_levels).lower() in ("true", "1"):
-                        incompat.append("packed_levels")
                     if incompat:
                         log.warning(
                             "histogram_pool_size is ignored for the "
@@ -179,8 +177,13 @@ class GBDT:
                        if config.grow_policy == "depthwise" else 1.0),
             hist_pool=hist_pool,
             lean_ft=lean_ft,
-            packed=str(config.packed_levels).lower() in ("true", "1"),
         )
+        if str(config.packed_levels).lower() in ("true", "1"):
+            log.warning(
+                "packed_levels was an experiment falsified on this runtime "
+                "(10-24x slower; see docs/PERF_NOTES.md) and its "
+                "implementation is archived on branch archive/packed-levels; "
+                "the flag is ignored")
         if (config.feature_fraction_bynode < 1.0
                 and config.grow_policy != "depthwise"):
             log.warning("feature_fraction_bynode is only implemented for the "

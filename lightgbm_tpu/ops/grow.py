@@ -59,13 +59,6 @@ class GrowParams:
     # the reference's pool-miss ConstructHistograms, traded exactly the same
     # way (memory for recompute)
     hist_pool: int = 0
-    # segment-packed depthwise levels (reference: DataPartition's
-    # partition-ordered rows, data_partition.hpp:113): rows kept in
-    # leaf-segment order; each level gathers only the smaller children into a
-    # chunk-aligned buffer and the packed kernel accumulates per-chunk slots —
-    # level cost stops scaling with frontier width. Serial + quantized +
-    # pallas path only (the grower falls back silently otherwise)
-    packed: bool = False
     # lean depthwise mode (histogram_pool_size for the DEPTHWISE grower,
     # VERDICT r3 weak #6): feature-tile width for the pass/search so live
     # histogram memory stays within the pool budget — the [L, 3, F, B]

@@ -82,13 +82,6 @@ class _DWState(NamedTuple):
     leaf_min: jnp.ndarray     # [L] monotone output bounds (ConstraintEntry)
     leaf_max: jnp.ndarray
     cegb: CEGBState           # CEGB bookkeeping (dummy arrays when off)
-    # segment packing (gp.packed; [1] dummies otherwise): perm[p] = row at
-    # packed position p (each leaf's rows contiguous); lop[p] = that
-    # position's leaf; seg_start/seg_len[l] = leaf l's position range
-    perm: jnp.ndarray         # [N] i32
-    lop: jnp.ndarray          # [N] i32
-    seg_start: jnp.ndarray    # [L] i32
-    seg_len: jnp.ndarray      # [L] i32
     tree: TreeArrays
 
 
@@ -199,14 +192,10 @@ def grow_tree_depthwise(bins: jnp.ndarray, g: jnp.ndarray, h: jnp.ndarray,
     # the psum (each shard contributes real-valued mass)
     quant = (H.make_quant(g, h, c, qseed, const_hess=gp.const_hess)
              if gp.quant else None)
-    # segment packing requires the quantized pallas path, serial execution,
-    # and no forced-split overrides (voting re-measures both children and has
-    # its own exchange path)
-    packed = (gp.packed and quant is not None and quant.hq is not None
-              and bins_T is not None
-              and not gp.axis_name and gp.voting_top_k == 0
-              and forced is None and not sp.has_cegb
-              and n * f < (1 << 31))  # flat row*F+feat index stays in int32
+    # (The segment-packed level-pass experiment that used to live here is
+    # archived on branch `archive/packed-levels`: row compaction measured
+    # 10-24x slower on this runtime — per-level XLA gathers dominate. See
+    # docs/PERF_NOTES.md "negative results".)
     hist0 = _psum(H.hist_leaf(bins, g, h, c, B, gp.hist_impl, bins_T=bins_T,
                               quant=quant),
                   gp)                                                # [3, F, B]
@@ -239,14 +228,6 @@ def grow_tree_depthwise(bins: jnp.ndarray, g: jnp.ndarray, h: jnp.ndarray,
         leaf_min=jnp.full(L, -jnp.inf),
         leaf_max=jnp.full(L, jnp.inf),
         cegb=cegb,
-        perm=(jnp.arange(n, dtype=jnp.int32) if packed
-              else jnp.zeros(1, jnp.int32)),
-        lop=(jnp.zeros(n, dtype=jnp.int32) if packed
-             else jnp.zeros(1, jnp.int32)),
-        seg_start=(jnp.zeros(L, jnp.int32) if packed
-                   else jnp.zeros(1, jnp.int32)),
-        seg_len=(jnp.zeros(L, jnp.int32).at[0].set(n) if packed
-                 else jnp.zeros(1, jnp.int32)),
         tree=_empty_tree(L, B),
     )
     # root leaf value (kept if nothing splits)
@@ -257,105 +238,6 @@ def grow_tree_depthwise(bins: jnp.ndarray, g: jnp.ndarray, h: jnp.ndarray,
         leaf_count=state.tree.leaf_count.at[0].set(c0)))
 
     leaves_iota = jnp.arange(L, dtype=jnp.int32)
-
-    if packed:
-        from .pallas_hist import _CHUNK as _PK_CH
-        bins_flat = bins.reshape(-1)
-
-    def packed_pass(st, SLOTS, sel, feat, thr, dleft, new_leaf,
-                    leaf_of_slot, slot_used, is_cat_v, member_v):
-        """Segment-packed level pass (reference analog: DataPartition::Split
-        ordering + ConstructHistograms over the partition,
-        data_partition.hpp:113 + dense_bin.hpp:77-105).
-
-        Routes the splitting segments in POSITION space, stably partitions
-        each segment left|right via masked cumsums (the row permutation stays
-        piecewise-sequential, so the bin-matrix gather below has locality),
-        gathers only the smaller children into a chunk-aligned packed buffer,
-        and accumulates per-chunk slots with hist_packed_q8 — level cost is
-        ~(rows measured)/chunk MXU tiles instead of scaling with the frontier
-        width."""
-        CH = _PK_CH
-        NPACK = ((n // 2 + SLOTS * CH) // CH + 1) * CH
-        G = NPACK // CH
-        pos = jnp.arange(n, dtype=jnp.int32)
-        leaf_p = st.lop                                         # [N]
-        row_p = st.perm
-        sel_p = sel[leaf_p]
-        featc = jnp.clip(feat[leaf_p], 0, f - 1)
-        colv = jnp.take(bins_flat, row_p * f + featc).astype(jnp.int32)
-        nav = na_bin[featc]
-        is_na = colv == nav
-        go_right = jnp.where(is_na, ~dleft[leaf_p], colv > thr[leaf_p])
-        if is_cat_v is not None:
-            icp = is_cat_v[leaf_p]
-            mem = jnp.take(member_v.reshape(-1), leaf_p * B + colv) > 0.5
-            go_right = jnp.where(icp, ~mem, go_right)
-        go_right = go_right & sel_p
-        left_mask = sel_p & ~go_right
-        lm32 = left_mask.astype(jnp.int32)
-        c1 = jnp.cumsum(lm32)                                   # inclusive
-
-        def excl(idx):   # exclusive prefix of left_mask at position idx
-            return jnp.where(idx > 0, c1[jnp.clip(idx - 1, 0, n - 1)], 0)
-
-        seg_base = excl(st.seg_start)                           # [L]
-        lcnt = excl(st.seg_start + st.seg_len) - seg_base       # [L]
-        left_before = (c1 - lm32) - seg_base[leaf_p]            # [N]
-        off_in_seg = pos - st.seg_start[leaf_p]
-        new_pos = jnp.where(
-            ~sel_p, pos,
-            jnp.where(left_mask,
-                      st.seg_start[leaf_p] + left_before,
-                      st.seg_start[leaf_p] + lcnt[leaf_p]
-                      + (off_in_seg - left_before)))
-        perm2 = jnp.zeros_like(row_p).at[new_pos].set(row_p)
-        child = jnp.where(go_right, new_leaf[leaf_p], leaf_p)
-        lop2 = jnp.zeros_like(leaf_p).at[new_pos].set(child)
-        ss2 = _scatter_set(st.seg_start, new_leaf,
-                           st.seg_start + lcnt, sel)
-        slen2 = _scatter_set(
-            _scatter_set(st.seg_len, leaves_iota, lcnt, sel),
-            new_leaf, st.seg_len - lcnt, sel)
-        rcnt = st.seg_len - lcnt
-        sm_left = lcnt <= rcnt                                  # [L]
-        sm_start = jnp.where(sm_left, st.seg_start, st.seg_start + lcnt)
-        sm_len = jnp.where(sel, jnp.where(sm_left, lcnt, rcnt), 0)
-        # chunk-aligned slot schedule over the packed buffer
-        los_c = jnp.minimum(leaf_of_slot, L - 1)
-        sm_len_s = jnp.where(slot_used, sm_len[los_c], 0)       # [SLOTS]
-        sm_start_s = sm_start[los_c]
-        plen_s = ((sm_len_s + CH - 1) // CH) * CH
-        cum_end = jnp.cumsum(plen_s)
-        off_s = cum_end - plen_s
-        cpos = jnp.arange(G, dtype=jnp.int32) * CH
-        soc = jnp.searchsorted(cum_end, cpos, side="right").astype(jnp.int32)
-        soc = jnp.minimum(soc, SLOTS)                           # SLOTS = dummy
-        q = jnp.arange(NPACK, dtype=jnp.int32)
-        sq = soc[q // CH]
-        sqc = jnp.minimum(sq, SLOTS - 1)
-        within = q - off_s[sqc]
-        validq = (sq < SLOTS) & (within >= 0) & (within < sm_len_s[sqc])
-        srcp = jnp.clip(sm_start_s[sqc] + within, 0, n - 1)
-        rowq = perm2[srcp]
-        binsP = jnp.take(bins_T, rowq, axis=1)                  # [F, NPACK]
-        z8 = jnp.int8(0)
-        gqP = jnp.where(validq, jnp.take(quant.gq, rowq), z8)
-        hqP = jnp.where(validq, jnp.take(quant.hq, rowq), z8)
-        cqP = jnp.where(validq, jnp.take(quant.cq, rowq), z8)
-        # accumulate with the S-wide (pipelined) kernel over the PACKED
-        # buffer: the row compaction (~N/2 rows, only smaller children) is
-        # where the win is. (A chunk-slot-indexed accumulator kernel with a
-        # scalar-prefetched output index was tried and measured 36x slower
-        # end-to-end: the data-dependent output index defeats Mosaic's
-        # double buffering.) _kernel_q8 zero-initializes every slot block
-        # and masks weights by slot, so empty slots come back exactly zero.
-        slotq = jnp.where(validq, sq, SLOTS)
-        from .pallas_hist import hist_pallas_q8
-        hp = hist_pallas_q8(binsP, gqP, hqP, cqP, slotq, SLOTS, B,
-                            quant.scale_g, quant.scale_h,
-                            interpret=jax.default_backend() == "cpu")
-        return hp, sm_left, perm2, lop2, ss2, slen2
 
     def level(st: _DWState, SLOTS: int, lvl):
         # ---- per-node feature sampling (feature_fraction_bynode;
@@ -496,50 +378,38 @@ def grow_tree_depthwise(bins: jnp.ndarray, g: jnp.ndarray, h: jnp.ndarray,
         leaf_of_slot = _scatter_set(jnp.full(SLOTS, _OOB, jnp.int32),
                                     idx_in_lvl, leaves_iota, sel)
         slot_used = leaf_of_slot < L
-        perm2, lop2, ss2, slen2 = st.perm, st.lop, st.seg_start, st.seg_len
-        if packed:
-            cat_on = bool(sp.cat_features) or sp.has_bundles
-            is_cat_v = (res.is_cat & sel) if cat_on else None
-            member_v = ((res.cat_member & sel[:, None])
-                        .astype(jnp.float32) if cat_on else None)
-            (hist_pass, small_is_left, perm2, lop2, ss2, slen2) = \
-                packed_pass(st, SLOTS, sel, feat, thr, dleft, new_leaf,
-                            leaf_of_slot, slot_used, is_cat_v, member_v)
-            leaf_id2 = st.leaf_id    # row-space ids rebuilt after the loop
-            vote_mask = None
+        if voting:
+            # voting mode measures BOTH children fresh (no sibling
+            # subtraction): the next level's vote needs full local
+            # histograms of the whole frontier, and parent-derived entries
+            # would mix earlier elected sets (shard-divergent ->
+            # collective deadlock)
+            S_pass = 2 * SLOTS
+            slot_l_tab = jnp.where(sel, idx_in_lvl * 2, S_pass)
+            slot_r_tab = jnp.where(sel, idx_in_lvl * 2 + 1, S_pass)
         else:
-            if voting:
-                # voting mode measures BOTH children fresh (no sibling
-                # subtraction): the next level's vote needs full local
-                # histograms of the whole frontier, and parent-derived entries
-                # would mix earlier elected sets (shard-divergent ->
-                # collective deadlock)
-                S_pass = 2 * SLOTS
-                slot_l_tab = jnp.where(sel, idx_in_lvl * 2, S_pass)
-                slot_r_tab = jnp.where(sel, idx_in_lvl * 2 + 1, S_pass)
-            else:
-                S_pass = SLOTS
-                # slot only for the smaller child; larger sibling = parent
-                # minus smaller
-                slot_l_tab = jnp.where(sel & small_is_left, idx_in_lvl, SLOTS)
-                slot_r_tab = jnp.where(sel & ~small_is_left, idx_in_lvl,
-                                       SLOTS)
-            tables = H.RouteTables(
-                feat=jnp.where(sel, feat, -1),
-                thr=thr,
-                dleft=dleft.astype(jnp.int32),
-                new_leaf=new_leaf,
-                slot_left=slot_l_tab,
-                slot_right=slot_r_tab,
-                is_cat=(res.is_cat & sel).astype(jnp.int32)
-                if (sp.cat_features or sp.has_bundles) else None,
-                member=(res.cat_member & sel[:, None]).astype(jnp.float32)
-                if (sp.cat_features or sp.has_bundles) else None,
-            )
-            hist_pass, leaf_id2 = H.hist_routed(
-                bins, g, h, c, st.leaf_id, tables, na_bin, S_pass, B,
-                gp.hist_impl, bins_T=bins_T, quant=quant)
-        if (not packed) and voting:
+            S_pass = SLOTS
+            # slot only for the smaller child; larger sibling = parent
+            # minus smaller
+            slot_l_tab = jnp.where(sel & small_is_left, idx_in_lvl, SLOTS)
+            slot_r_tab = jnp.where(sel & ~small_is_left, idx_in_lvl,
+                                   SLOTS)
+        tables = H.RouteTables(
+            feat=jnp.where(sel, feat, -1),
+            thr=thr,
+            dleft=dleft.astype(jnp.int32),
+            new_leaf=new_leaf,
+            slot_left=slot_l_tab,
+            slot_right=slot_r_tab,
+            is_cat=(res.is_cat & sel).astype(jnp.int32)
+            if (sp.cat_features or sp.has_bundles) else None,
+            member=(res.cat_member & sel[:, None]).astype(jnp.float32)
+            if (sp.cat_features or sp.has_bundles) else None,
+        )
+        hist_pass, leaf_id2 = H.hist_routed(
+            bins, g, h, c, st.leaf_id, tables, na_bin, S_pass, B,
+            gp.hist_impl, bins_T=bins_T, quant=quant)
+        if voting:
             # ---- voting-parallel histogram exchange (PV-Tree; reference:
             # VotingParallelTreeLearner GlobalVoting + CopyLocalHistogram,
             # voting_parallel_tree_learner.cpp:170-366). Per-LEVEL election
@@ -579,7 +449,7 @@ def grow_tree_depthwise(bins: jnp.ndarray, g: jnp.ndarray, h: jnp.ndarray,
             em_rows = jnp.broadcast_to(elected_mask[None, :], (L, f))
             vote_mask = _scatter_set(st.vote_mask, leaves_iota, em_rows, sel)
             vote_mask = _scatter_set(vote_mask, new_leaf, em_rows, sel)
-        elif not packed:
+        else:
             hist_pass = _psum(hist_pass, gp)
             vote_mask = None
 
@@ -641,7 +511,6 @@ def grow_tree_depthwise(bins: jnp.ndarray, g: jnp.ndarray, h: jnp.ndarray,
             leaf_c=leaf_c2, active=active2, parent_node=pn2, parent_right=pr2,
             leaf_min=leaf_min2, leaf_max=leaf_max2,
             cegb=cegb2,
-            perm=perm2, lop=lop2, seg_start=ss2, seg_len=slen2,
             tree=tr,
         ), num_sel
 
@@ -679,12 +548,6 @@ def grow_tree_depthwise(bins: jnp.ndarray, g: jnp.ndarray, h: jnp.ndarray,
 
         state, _, _ = jax.lax.while_loop(
             cond, body, (state, jnp.int32(n_unroll), last_sel))
-
-    if packed:
-        # row-space leaf ids from the final packed order (deferred from the
-        # per-level passes: position space needs no leaf_id until now)
-        state = state._replace(leaf_id=jnp.zeros(n, jnp.int32)
-                               .at[state.perm].set(state.lop))
 
     if gp.quant:
         # leaf renewal from EXACT sums (quantized-training paper: splits
@@ -794,7 +657,7 @@ def grow_tree_depthwise_lean(bins: jnp.ndarray, g, h, c, num_bins, na_bin,
       per-tile winners — live histogram memory is [2S, 3, ft, B] for one
       tile, chosen by GBDT to fit histogram_pool_size.
 
-    Not combined with voting/CEGB/forced-splits/ff_bynode/packed (GBDT keeps
+    Not combined with voting/CEGB/forced-splits/ff_bynode (GBDT keeps
     the default grower and warns). Ties across missing-direction planes of
     different tiles may break differently from the monolithic search (both
     prefer the lower feature id within a plane).
@@ -815,10 +678,7 @@ def grow_tree_depthwise_lean(bins: jnp.ndarray, g, h, c, num_bins, na_bin,
     quant = (H.make_quant(g, h, c, qseed, const_hess=gp.const_hess)
              if gp.quant else None)
     if quant is not None and not use_pallas:
-        gm = quant.gq.astype(jnp.float32) * (quant.scale_g / 127.0)
-        hm = (quant.hq if quant.hq is not None else quant.cq).astype(
-            jnp.float32) * (quant.scale_h / 127.0)
-        cm = quant.cq.astype(jnp.float32)
+        gm, hm, cm = H.dequant_rows(quant)
     else:
         gm, hm, cm = g, h, c
     interp = jax.default_backend() == "cpu"

@@ -365,76 +365,3 @@ def test_quantized_training_quality_cpu():
         aucs[uq] = float(_auc(jnp.asarray(y), jnp.asarray(prob), None))
     assert aucs["true"] > 0.81, aucs
     assert abs(aucs["true"] - aucs["false"]) < 0.01, aucs
-
-
-def test_packed_levels_match_unpacked():
-    """Segment-packed depthwise growth (gp.packed: partition-ordered rows +
-    per-chunk-slot packed kernel) must produce the same tree as the unpacked
-    fused route+hist path — identical split structure and exact count
-    channels (reference analog: DataPartition ordering changes scan order,
-    never results, data_partition.hpp:113)."""
-    import dataclasses
-    from lightgbm_tpu.ops.grow_depthwise import grow_tree_depthwise
-    rng = np.random.RandomState(11)
-    n, f, b, L = 5000, 6, 16, 15
-    bins = jnp.asarray(rng.randint(0, b, size=(n, f)).astype(np.uint8))
-    g = jnp.asarray(rng.randn(n).astype(np.float32))
-    h = jnp.asarray(np.abs(rng.randn(n)).astype(np.float32) + 0.1)
-    c = jnp.ones(n, jnp.float32)
-    num_bins = jnp.full(f, b, dtype=jnp.int32)
-    na_bin = jnp.full(f, 256, dtype=jnp.int32)
-    fmask = jnp.ones(f, dtype=bool)
-    gp0 = GrowParams(num_leaves=L, max_bin=b, quant=True,
-                     split=SplitParams(min_data_in_leaf=5),
-                     hist_impl="pallas", packed=False)
-    gp1 = dataclasses.replace(gp0, packed=True)
-    t0, lid0 = grow_tree_depthwise(bins, g, h, c, num_bins, na_bin, fmask,
-                                   gp0, qseed=jnp.int32(3))
-    t1, lid1 = grow_tree_depthwise(bins, g, h, c, num_bins, na_bin, fmask,
-                                   gp1, qseed=jnp.int32(3))
-    assert int(t0.num_leaves) == int(t1.num_leaves) > 4
-    np.testing.assert_array_equal(np.asarray(t0.split_feature),
-                                  np.asarray(t1.split_feature))
-    np.testing.assert_array_equal(np.asarray(t0.threshold_bin),
-                                  np.asarray(t1.threshold_bin))
-    np.testing.assert_array_equal(np.asarray(t0.leaf_count),
-                                  np.asarray(t1.leaf_count))
-    np.testing.assert_allclose(np.asarray(t0.leaf_value),
-                               np.asarray(t1.leaf_value),
-                               rtol=1e-4, atol=1e-5)
-    np.testing.assert_array_equal(np.asarray(lid0), np.asarray(lid1))
-
-
-def test_packed_levels_with_bagging_and_categorical():
-    """Packed path with a bag mask (zero-weight rows) and a categorical
-    split must match the unpacked path."""
-    import dataclasses
-    from lightgbm_tpu.ops.grow_depthwise import grow_tree_depthwise
-    rng = np.random.RandomState(12)
-    n, f, b, L = 4000, 5, 16, 7
-    bins_np = rng.randint(0, b, size=(n, f)).astype(np.uint8)
-    bins_np[:, 2] = rng.randint(1, 9, size=n)  # categorical col, bins 1..8
-    bins = jnp.asarray(bins_np)
-    bag = (rng.rand(n) < 0.7).astype(np.float32)
-    g = jnp.asarray((rng.randn(n) * bag).astype(np.float32))
-    h = jnp.asarray((np.abs(rng.randn(n)) * bag + 0.01 * bag)
-                    .astype(np.float32))
-    c = jnp.asarray(bag)
-    num_bins = jnp.full(f, b, dtype=jnp.int32).at[2].set(9)
-    na_bin = jnp.full(f, 256, dtype=jnp.int32)
-    fmask = jnp.ones(f, dtype=bool)
-    gp0 = GrowParams(num_leaves=L, max_bin=b, quant=True,
-                     split=SplitParams(min_data_in_leaf=5, cat_features=(2,),
-                                       max_cat_to_onehot=2),
-                     hist_impl="pallas", packed=False)
-    gp1 = dataclasses.replace(gp0, packed=True)
-    t0, lid0 = grow_tree_depthwise(bins, g, h, c, num_bins, na_bin, fmask,
-                                   gp0, qseed=jnp.int32(5))
-    t1, lid1 = grow_tree_depthwise(bins, g, h, c, num_bins, na_bin, fmask,
-                                   gp1, qseed=jnp.int32(5))
-    assert int(t0.num_leaves) == int(t1.num_leaves)
-    np.testing.assert_array_equal(np.asarray(t0.split_feature),
-                                  np.asarray(t1.split_feature))
-    np.testing.assert_array_equal(np.asarray(t0.leaf_count),
-                                  np.asarray(t1.leaf_count))
-    np.testing.assert_array_equal(np.asarray(lid0), np.asarray(lid1))
