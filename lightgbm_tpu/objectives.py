@@ -184,10 +184,17 @@ class Quantile(RegressionL2):
 
 class Mape(RegressionL2):
     name = "mape"
-    is_constant_hessian = True
+    # The reference reports IsConstantHessian=true for MAPE
+    # (regression_objective.hpp:648) because there the 1/|label| factor rides
+    # as a label weight. OUR flag gates the q8 histogram hessian-channel
+    # elision, which requires h = h_const * bag01 per row — MAPE's
+    # h = w / max(1, |label|) varies per row, so it must stay False or the
+    # elided kernels would reconstruct count * max(h) instead of sum(h).
+    is_constant_hessian = False
 
     def init(self, label, weight, group=None):
-        super().init(label, weight, group)
+        super().init(label, weight, group)   # sets is_constant_hessian from
+        self.is_constant_hessian = False     # weights; force it back off
         w = weight if weight is not None else jnp.ones_like(label)
         self._mape_w = w / jnp.maximum(1.0, jnp.abs(label))
 

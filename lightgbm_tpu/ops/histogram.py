@@ -393,6 +393,17 @@ def _q8_h_arg(quant: QuantChannels):
     return (quant.cq, True) if quant.hq is None else (quant.hq, False)
 
 
+def dequant_rows(quant: QuantChannels):
+    """Per-row f32 (g, h, c) for non-pallas backends — the same numbers the
+    int32 accumulator would produce, up to f32 summation order. With elided
+    hessians (hq None) the count channel stands in: hq would be 127*cq."""
+    g = quant.gq.astype(jnp.float32) * (quant.scale_g / 127.0)
+    h = (quant.hq if quant.hq is not None else quant.cq).astype(
+        jnp.float32) * (quant.scale_h / 127.0)
+    c = quant.cq.astype(jnp.float32)
+    return g, h, c
+
+
 # ---------------------------------------------------------------------------
 # dispatch
 # ---------------------------------------------------------------------------
@@ -419,12 +430,7 @@ def hist_leaf(bins, g, h, c, num_bins, impl="auto", bins_T=None, quant=None):
                               num_bins, quant.scale_g, quant.scale_h,
                               const_hess=ch, interpret=interp)[0]
     if quant is not None:
-        # non-pallas backends: dequantize per row (same numbers the int32
-        # accumulator would produce, up to f32 summation order)
-        g = quant.gq.astype(jnp.float32) * (quant.scale_g / 127.0)
-        h = (quant.hq if quant.hq is not None else quant.cq).astype(
-            jnp.float32) * (quant.scale_h / 127.0)
-        c = quant.cq.astype(jnp.float32)
+        g, h, c = dequant_rows(quant)
     if impl == "scatter":
         return hist_leaf_scatter(bins, g, h, c, num_bins)
     if impl == "pallas":
@@ -450,10 +456,7 @@ def hist_routed(bins, g, h, c, leaf_id, tables, na_bin, num_slots, num_bins,
                 impl="auto", bins_T=None, quant=None):
     impl = pick_impl(impl)
     if quant is not None and impl != "pallas":
-        g = quant.gq.astype(jnp.float32) * (quant.scale_g / 127.0)
-        h = (quant.hq if quant.hq is not None else quant.cq).astype(
-            jnp.float32) * (quant.scale_h / 127.0)
-        c = quant.cq.astype(jnp.float32)
+        g, h, c = dequant_rows(quant)
     if impl == "scatter":
         return hist_routed_scatter(bins, g, h, c, leaf_id, tables, na_bin,
                                    num_slots, num_bins)
