@@ -32,10 +32,11 @@ from jax.experimental.pallas import tpu as pltpu
 
 _CHUNK = 1024          # rows per grid step (onehot block [F*B, C] bf16 ~3.7MB)
 # int8 kernel takes bigger chunks: the onehot block is half the bytes of the
-# bf16 one, and 2048 measured +4% end-to-end at 10M rows (3.73 vs 3.58
-# iters/sec); the bf16 kernel at 2048 would put onehot+accumulator+weights
-# near the VMEM ceiling at S=128, so it stays at 1024
-_CHUNK_Q8 = 2048
+# bf16 one, and the SWAR one-hot (r5) freed enough VMEM that 4096 fits even
+# at S=127 (onehot 7.3MB + acc 2.7MB + weights 1.5MB); fewer grid steps cut
+# the per-chunk fixed cost that dominates shallow passes. The bf16 kernel
+# stays at 1024 (hi/lo doubles its weight rows)
+_CHUNK_Q8 = 4096
 _ACC_ROWS_MAX = 2048   # Fg*B cap: keeps the f32 accumulator block <= ~6.3MB
 
 
@@ -176,8 +177,53 @@ def hist_leaf_pallas(bins_T, g, h, c, num_bins: int,
 # (127 * 16.9M = 2^31), far beyond any real per-cell mass.
 # ---------------------------------------------------------------------------
 
+def _onehot_i8(bins_i, fg: int, b: int, chunk: int, swar: bool):
+    """int8 bin one-hot in [Fg*B, C] lane layout from int32 bins [Fg, C].
+
+    swar=False: B int32 broadcast-compares (Mosaic on v5e rejects sub-word
+    vector cmpi, so the compare width is fixed at 32 bits).
+
+    swar=True: build FOUR bin rows per int32 lane-op (VERDICT r4 next #5;
+    reference analog: 4-features-per-DWORD packing,
+    gpu_tree_learner.h:200-207 — packed along the BIN axis here). Each bin
+    byte is splatted once (v * 0x01010101, hoisted out of the bin loop),
+    XORed against the packed 4-bin constant (4k | 4k+1<<8 | 4k+2<<16 |
+    4k+3<<24), and zero bytes are detected with the carry-free +0x7F7F7F7F
+    test — exact because v, b < 128 keeps every x byte < 0x80, so the
+    per-byte add can never carry. A logical >>7 turns the 0x80 match bits
+    into 0x01 bytes (logical, NOT arithmetic: a byte-3 match sets bit 31 and
+    an arithmetic shift would smear the sign across the byte), and
+    pltpu.bitcast unpacks the 4 result bytes onto sublanes in little-endian
+    order — row 4k+j of the one-hot = byte j of packed row k, i.e. bin
+    b = 4k + j, exactly the [Fg, B, C] row order. Net: the [Fg, B/4, C]
+    intermediate has 1/4 the int32 lanes of the compare path's [Fg, B, C]
+    at ~4 ops per lane vs 2 — half the VPU work on the kernel's dominant
+    non-MXU cost."""
+    if swar:
+        vs = bins_i * jnp.int32(0x01010101)                     # [Fg, C]
+        vb = jax.lax.broadcast_in_dim(vs, (fg, b // 4, chunk), (0, 2))
+        k4 = jax.lax.broadcasted_iota(jnp.int32, (fg, b // 4, chunk), 1)
+        bconst = k4 * jnp.int32(4 * 0x01010101) + jnp.int32(0x03020100)
+        x = vb ^ bconst
+        t = x + jnp.int32(0x7F7F7F7F)                 # byte bit7 set iff != 0
+        hit = ~t & jnp.int32(0x80808080 - (1 << 32))  # i32-range constant
+        oh4 = jax.lax.shift_right_logical(hit, jax.lax.full_like(hit, 7))
+        return pltpu.bitcast(oh4.reshape(fg * (b // 4), chunk), jnp.int8)
+    bb = jax.lax.broadcast_in_dim(bins_i, (fg, b, chunk), (0, 2))
+    iota_b = jax.lax.broadcasted_iota(jnp.int32, (fg, b, chunk), 1)
+    return (bb == iota_b).astype(jnp.int8).reshape(fg * b, chunk)
+
+
+def _swar_ok(b: int, interpret: bool) -> bool:
+    """SWAR one-hot requires bins/bin ids < 128 (carry-free byte test), a
+    bin axis divisible by 4, and compiled Mosaic (pltpu.bitcast semantics
+    are target-defined; the interpreter keeps the reference compare path)."""
+    return (not interpret) and b % 4 == 0 and b <= 128
+
+
 def _kernel_q8(bins_ref, gq_ref, hq_ref, c_ref, slot_ref, out_ref, *,
-               fg: int, b: int, s: int, chunk: int, nch: int = 3):
+               fg: int, b: int, s: int, chunk: int, nch: int = 3,
+               swar: bool = False):
     """One (feature-group j, row-chunk i) grid step, int8 x int8 -> int32.
 
     bins_ref: [Fg, C] uint8; gq/hq/c_ref: [C] int8; slot_ref: [C] i32;
@@ -190,13 +236,8 @@ def _kernel_q8(bins_ref, gq_ref, hq_ref, c_ref, slot_ref, out_ref, *,
     def _():
         out_ref[:] = jnp.zeros_like(out_ref)
 
-    # one-hot compares in int32 (Mosaic on v5e rejects sub-word vector cmpi;
-    # an int8-compare variant fails to compile with "Target does not support
-    # this comparison")
     bins_i = bins_ref[:].astype(jnp.int32)                      # [Fg, C]
-    bb = jax.lax.broadcast_in_dim(bins_i, (fg, b, chunk), (0, 2))
-    iota_b = jax.lax.broadcasted_iota(jnp.int32, (fg, b, chunk), 1)
-    onehot = (bb == iota_b).astype(jnp.int8).reshape(fg * b, chunk)
+    onehot = _onehot_i8(bins_i, fg, b, chunk, swar)
 
     # weights [S*nch, C] int8: (gq[, hq], count) broadcast to slot groups,
     # masked by the row's slot (mask arithmetic in int32 — Mosaic's
@@ -238,6 +279,10 @@ def hist_pallas_q8(bins_T: jnp.ndarray, gq: jnp.ndarray, hq: jnp.ndarray,
     f, n = bins_T.shape
     b, s = num_bins, num_slots
     nch = 2 if const_hess else 3
+    if chunk == _CHUNK_Q8 and not _swar_ok(b, interpret):
+        # the 4096 default is budgeted for the SWAR one-hot; the compare
+        # path's [Fg, B, C] int32 intermediate needs the old smaller chunk
+        chunk = 2048
 
     fg = max(1, min(f, _ACC_ROWS_MAX // b))
     n_fg = -(-f // fg)
@@ -254,7 +299,7 @@ def hist_pallas_q8(bins_T: jnp.ndarray, gq: jnp.ndarray, hq: jnp.ndarray,
     n_chunks = bins_T.shape[1] // chunk
 
     kern = functools.partial(_kernel_q8, fg=fg, b=b, s=s, chunk=chunk,
-                             nch=nch)
+                             nch=nch, swar=_swar_ok(b, interpret))
     out = pl.pallas_call(
         kern,
         grid=(n_fg, n_chunks),
@@ -294,7 +339,7 @@ def hist_pallas_q8(bins_T: jnp.ndarray, gq: jnp.ndarray, hq: jnp.ndarray,
 
 
 def _kernel_q8_fused(*refs, f: int, b: int, s: int, l: int, chunk: int,
-                     has_cat: bool, nch: int = 3):
+                     has_cat: bool, nch: int = 3, swar: bool = False):
     """Fused route + int8 histogram for ONE feature group (F*B <= block cap).
 
     Per level the two-pass scheme reads the bin matrix twice (route kernel,
@@ -360,10 +405,8 @@ def _kernel_q8_fused(*refs, f: int, b: int, s: int, l: int, chunk: int,
     lid_out[:] = lid2.astype(jnp.int32).reshape(chunk)
     slot = jnp.minimum(slot_f.astype(jnp.int32), s)              # [1, C]
 
-    # ---- int8 histogram (see _kernel_q8) ----
-    bb = jax.lax.broadcast_in_dim(bins_i, (f, b, chunk), (0, 2))
-    iota_b = jax.lax.broadcasted_iota(jnp.int32, (f, b, chunk), 1)
-    onehot = (bb == iota_b).astype(jnp.int8).reshape(f * b, chunk)
+    # ---- int8 histogram (see _kernel_q8 / _onehot_i8) ----
+    onehot = _onehot_i8(bins_i, f, b, chunk, swar)
     g = gq_ref[:].reshape(1, chunk).astype(jnp.int32)
     c = cq_ref[:].reshape(1, chunk).astype(jnp.int32)
     if nch == 3:
@@ -397,10 +440,13 @@ def hist_routed_fused_q8(bins_T, gq, hq, cq, leaf_id, tables, na_bin,
     nch = 2 if const_hess else 3
     assert f * b <= _ACC_ROWS_MAX
     if chunk == 0:
-        # doubled chunk halves per-chunk fixed costs; at deep S the
-        # [S*nch, C] weights + [FB, C] onehot + route blocks near the 16MB
-        # VMEM ceiling, so fall back to 2048
-        chunk = 4096 if s * nch <= 192 else _CHUNK_Q8
+        # doubled chunk halves per-chunk fixed costs; the SWAR int8
+        # one-hot keeps 4096 under the 16MB VMEM ceiling through S=127
+        # (measured 35 -> 31.7 ms at S=127). Without SWAR (B > 128 or
+        # interpret) the compare path's wider intermediates keep the old
+        # 192-row threshold
+        wide_ok = 384 if _swar_ok(b, interpret) else 192
+        chunk = 4096 if s * nch <= wide_ok else 2048
 
     has_cat = tables.is_cat is not None
     iscat_row = (tables.is_cat.astype(jnp.float32) if has_cat
@@ -437,7 +483,8 @@ def hist_routed_fused_q8(bins_T, gq, hq, cq, leaf_id, tables, na_bin,
         args.append(tables.member.astype(jnp.float32).T)
 
     kern = functools.partial(_kernel_q8_fused, f=f, b=b, s=s, l=l,
-                             chunk=chunk, has_cat=has_cat, nch=nch)
+                             chunk=chunk, has_cat=has_cat, nch=nch,
+                             swar=_swar_ok(b, interpret))
     out, lid2 = pl.pallas_call(
         kern,
         grid=(n_chunks,),
