@@ -71,3 +71,82 @@ def predict_for_mat(booster, data_addr: int, nrow: int, ncol: int,
 def save_model(booster, path: str) -> int:
     booster.save_model(path)
     return 0
+
+
+# ---- dataset-from-memory + stepwise training (native/capi.cpp; reference:
+# LGBM_DatasetCreateFromMat / LGBM_DatasetSetField / LGBM_BoosterCreate /
+# LGBM_BoosterUpdateOneIter, c_api.h:215,322,387,482) ----
+
+def _parse_params(params_str: str) -> dict:
+    """Reference parameter-string form: space-separated k=v tokens
+    (Config::Str2Map, config.cpp)."""
+    out = {}
+    for tok in (params_str or "").split():
+        if "=" in tok:
+            k, v = tok.split("=", 1)
+            out[k.strip()] = v.strip()
+    return out
+
+
+def dataset_from_mat(data_addr: int, nrow: int, ncol: int, params_str: str,
+                     reference):
+    """Dense f64 row-major matrix -> Dataset handle. The buffer is COPIED
+    (the host's matrix may be freed right after this call, like the
+    reference which pushes rows into its own bin buffers)."""
+    from .basic import Dataset
+    src = (ctypes.c_double * (nrow * ncol)).from_address(data_addr)
+    x = np.frombuffer(src, dtype=np.float64).reshape(nrow, ncol).copy()
+    return Dataset(x, params=_parse_params(params_str), reference=reference)
+
+
+def dataset_set_field(ds, name: str, data_addr: int, n: int,
+                      dtype: int) -> int:
+    """label/weight/init_score as f64 (dtype 0), group sizes as i32
+    (dtype 1) — the reference's SetField type convention (c_api.h:322)."""
+    if dtype == 1:
+        src = (ctypes.c_int32 * n).from_address(data_addr)
+        arr = np.frombuffer(src, dtype=np.int32).copy()
+    else:
+        src = (ctypes.c_double * n).from_address(data_addr)
+        arr = np.frombuffer(src, dtype=np.float64).copy()
+    if name == "label":
+        ds.set_label(arr)
+    elif name == "weight":
+        ds.set_weight(arr)
+    elif name == "init_score":
+        ds.set_init_score(arr)
+    elif name == "group" or name == "query":
+        ds.set_group(arr.astype(np.int64))
+    else:
+        raise ValueError(f"unknown field name {name!r}")
+    return 0
+
+
+def dataset_num_data(ds) -> int:
+    return int(ds.num_data)
+
+
+def dataset_num_feature(ds) -> int:
+    return int(ds.num_features)
+
+
+def booster_create(ds, params_str: str):
+    from .basic import Booster
+    return Booster(params=_parse_params(params_str), train_set=ds)
+
+
+def booster_add_valid(booster, valid_ds, name: str) -> int:
+    booster.add_valid(valid_ds, name)
+    return 0
+
+
+def booster_update_one_iter(booster) -> int:
+    return 1 if booster.update() else 0
+
+
+def booster_finish_training(booster) -> int:
+    """Flush the lagged finished-check queue (drops trailing all-stump
+    iterations) — call after the update loop, before saving."""
+    if booster._gbdt is not None:
+        booster._gbdt.finish_training()
+    return 0

@@ -279,12 +279,15 @@ def hist_pallas_q8(bins_T: jnp.ndarray, gq: jnp.ndarray, hq: jnp.ndarray,
     f, n = bins_T.shape
     b, s = num_bins, num_slots
     nch = 2 if const_hess else 3
-    if chunk == _CHUNK_Q8 and not _swar_ok(b, interpret):
-        # the 4096 default is budgeted for the SWAR one-hot; the compare
-        # path's [Fg, B, C] int32 intermediate needs the old smaller chunk
-        chunk = 2048
-
     fg = max(1, min(f, _ACC_ROWS_MAX // b))
+    if chunk == _CHUNK_Q8:
+        # the 4096 default is budgeted for the SWAR one-hot at the bench
+        # shape (fg*b = 1792 rows measured fitting VMEM at S=127); wider
+        # feature groups (fg*b = 2048 at 700 features: measured 16.75MB,
+        # 764KB over the scoped-vmem limit) or the compare path's int32
+        # broadcast intermediates keep the old 2048 chunk
+        if not _swar_ok(b, interpret) or fg * b > 1792 or s * nch > 384:
+            chunk = 2048
     n_fg = -(-f // fg)
     f_pad = n_fg * fg
     if f_pad != f:
@@ -445,7 +448,7 @@ def hist_routed_fused_q8(bins_T, gq, hq, cq, leaf_id, tables, na_bin,
         # (measured 35 -> 31.7 ms at S=127). Without SWAR (B > 128 or
         # interpret) the compare path's wider intermediates keep the old
         # 192-row threshold
-        wide_ok = 384 if _swar_ok(b, interpret) else 192
+        wide_ok = 384 if (_swar_ok(b, interpret) and f * b <= 1792) else 192
         chunk = 4096 if s * nch <= wide_ok else 2048
 
     has_cat = tables.is_cat is not None

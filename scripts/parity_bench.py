@@ -66,15 +66,167 @@ def write_tsv(path, X, y):
     np.savetxt(path, data, fmt="%.7g", delimiter="\t")
 
 
+def synth_ranking(n_rows, n_feat=700, n_rel_feat=40, seed=0,
+                  mean_docs=25):
+    """Yahoo-LTR-shaped synthetic ranking set (BASELINE target:
+    docs/Experiments.rst:108 — 473K docs x 700 features, graded relevance
+    0-4, NDCG@10). Relevance is a noisy monotone function of a sparse
+    linear score over the first n_rel_feat features; query sizes are
+    geometric-ish around mean_docs like web-search result lists."""
+    rng = np.random.RandomState(seed)
+    X = rng.randn(n_rows, n_feat).astype(np.float32)
+    w = np.zeros(n_feat)
+    w[:n_rel_feat] = rng.randn(n_rel_feat)
+    score = X @ w / np.sqrt(n_rel_feat) + 0.7 * rng.randn(n_rows)
+    # map to graded relevance 0..4 with a realistic skew (most docs bad)
+    qtl = np.quantile(score, [0.55, 0.8, 0.93, 0.985])
+    y = np.digitize(score, qtl).astype(np.float32)
+    sizes = []
+    total = 0
+    while total < n_rows:
+        sz = max(2, int(rng.geometric(1.0 / mean_docs)))
+        sz = min(sz, n_rows - total)
+        sizes.append(sz)
+        total += sz
+    if sizes[-1] < 2 and len(sizes) > 1:
+        sizes[-2] += sizes[-1]
+        sizes.pop()
+    return X, y, np.asarray(sizes, dtype=np.int64)
+
+
+def ndcg_at_k(y, pred, group, k=10):
+    """Reference NDCG@k semantics (metric/dcg_calculator.cpp): gain 2^rel-1,
+    log2 discounts, queries with no relevant docs count as 1."""
+    out = []
+    pos = 0
+    disc = 1.0 / np.log2(np.arange(2, k + 2))
+    for g in group:
+        yy = y[pos: pos + g]
+        pp = pred[pos: pos + g]
+        pos += g
+        if yy.max() <= 0:
+            out.append(1.0)
+            continue
+        kk = min(k, g)
+        order = np.argsort(-pp, kind="stable")
+        gains = (2.0 ** yy - 1.0)
+        dcg = (gains[order][:kk] * disc[:kk]).sum()
+        ideal = (np.sort(gains)[::-1][:kk] * disc[:kk]).sum()
+        out.append(dcg / ideal)
+    return float(np.mean(out))
+
+
+def run_ranking(args):
+    """Ranking parity at Yahoo shape vs the reference CLI (VERDICT r4
+    next #6). Writes a {task: 'ranking'} entry + parity record."""
+    import time as _t
+    os.makedirs(args.workdir, exist_ok=True)
+    n, f = args.rows, args.features
+    X, y, group = synth_ranking(n + args.valid_rows, f)
+    bounds = np.cumsum(group)
+    q_train = int(np.searchsorted(bounds, n))
+    n_train = int(bounds[q_train - 1])
+    g_train, g_valid = group[:q_train], group[q_train:]
+    Xt, yt = X[:n_train], y[:n_train]
+    Xv, yv = X[n_train:], y[n_train:]
+
+    out = {"entries": [], "parity": {}}
+    if os.path.exists(args.out):
+        with open(args.out) as fh:
+            out = json.load(fh)
+    key = {"task": "ranking", "rows": n_train, "features": f,
+           "iters": args.iters, "leaves": args.leaves, "bins": args.bins}
+    entry = next((e for e in out["entries"]
+                  if all(e.get(k) == v for k, v in key.items())), None)
+
+    if not args.skip_ref:
+        tr = os.path.join(args.workdir, f"rank_train_{n_train}_{f}.tsv")
+        va = os.path.join(args.workdir, f"rank_valid_{len(yv)}_{f}.tsv")
+        if not os.path.exists(tr):
+            print(f"writing {tr} ...", file=sys.stderr)
+            write_tsv(tr, Xt, yt)
+            np.savetxt(tr + ".query", g_train, fmt="%d")
+        if not os.path.exists(va):
+            write_tsv(va, Xv, yv)
+            np.savetxt(va + ".query", g_valid, fmt="%d")
+        print("training reference CLI (lambdarank) ...", file=sys.stderr)
+        preds, ref_time = train_reference(
+            args.ref_cli, args.workdir, tr, va, args.leaves, args.bins,
+            args.iters, args.lr, objective="lambdarank", metric="ndcg",
+            extra_conf=("eval_at=10",), predict_raw=True,
+            predict_on=("valid",))
+        ref_pred = preds["valid"]
+        entry = dict(key)
+        entry["ref_valid_ndcg10"] = round(ndcg_at_k(yv, ref_pred, g_valid), 6)
+        entry["ref_train_time_s"] = round(ref_time, 1)
+        out["entries"] = [e for e in out["entries"]
+                          if not all(e.get(k) == v for k, v in key.items())]
+        out["entries"].append(entry)
+        with open(args.out, "w") as fh:
+            json.dump(out, fh, indent=1)
+        print(f"reference: valid NDCG@10={entry['ref_valid_ndcg10']} "
+              f"time={ref_time:.1f}s", file=sys.stderr)
+
+    if not args.skip_tpu:
+        if entry is None:
+            sys.exit("no reference ranking entry; run without --skip-ref")
+        import jax
+        import lightgbm_tpu as lgb
+        params = {"objective": "lambdarank", "num_leaves": args.leaves,
+                  "max_bin": args.bins, "learning_rate": args.lr,
+                  "min_data_in_leaf": 20, "verbosity": -1,
+                  "metric": "ndcg", "eval_at": [10]}
+        t0 = _t.time()
+        ds = lgb.Dataset(Xt, label=yt, group=g_train, params=params)
+        ds.construct()
+        bin_time = _t.time() - t0
+        booster = lgb.Booster(params=params, train_set=ds)
+        t0 = _t.time()
+        for it in range(args.iters):
+            booster.update()
+            if (it + 1) % 50 == 0:
+                print(f"  iter {it + 1}/{args.iters} "
+                      f"t={_t.time() - t0:.1f}s", file=sys.stderr,
+                      flush=True)
+        jax.block_until_ready(booster.raw_train_score())
+        tpu_time = _t.time() - t0
+        pred = booster.predict(Xv, raw_score=True)
+        ndcg = ndcg_at_k(yv, np.asarray(pred), g_valid)
+        delta = abs(ndcg - entry["ref_valid_ndcg10"])
+        out["ranking_parity"] = {
+            **key,
+            "ref_valid_ndcg10": entry["ref_valid_ndcg10"],
+            "tpu_valid_ndcg10": round(ndcg, 6),
+            "delta_ndcg10": round(delta, 6),
+            "ref_train_time_s": entry["ref_train_time_s"],
+            "tpu_train_time_s": round(tpu_time, 1),
+            "tpu_bin_time_s": round(bin_time, 1),
+            "tpu_iters_per_sec": round(args.iters / tpu_time, 3),
+        }
+        print(f"tpu: valid NDCG@10={ndcg:.6f} "
+              f"(ref {entry['ref_valid_ndcg10']}) |delta|={delta:.6f} "
+              f"time={tpu_time:.1f}s (ref {entry['ref_train_time_s']}s)",
+              file=sys.stderr)
+        assert delta < 0.005, f"NDCG parity FAILED: {delta:.6f} >= 0.005"
+
+    with open(args.out, "w") as fh:
+        json.dump(out, fh, indent=1)
+    print(json.dumps(out.get("ranking_parity") or entry))
+
+
 def train_reference(cli, workdir, train_path, valid_path, leaves, bins, iters,
-                    lr, threads=0):
+                    lr, threads=0, objective="binary", metric="auc",
+                    extra_conf=(), predict_raw=False,
+                    predict_on=("train", "valid")):
+    """Drive the reference CLI: one train run + raw/prob predictions on the
+    requested splits. All parity tasks (binary, ranking) share this."""
     conf = os.path.join(workdir, "ref_train.conf")
     model = os.path.join(workdir, "ref_model.txt")
     lines = [
-        "task=train", "objective=binary", f"data={train_path}",
+        "task=train", f"objective={objective}", f"data={train_path}",
         f"num_leaves={leaves}", f"max_bin={bins}", f"num_iterations={iters}",
-        f"learning_rate={lr}", "min_data_in_leaf=20", "metric=auc",
-        f"output_model={model}", "verbosity=1",
+        f"learning_rate={lr}", "min_data_in_leaf=20", f"metric={metric}",
+        f"output_model={model}", "verbosity=1", *extra_conf,
     ]
     if threads:
         lines.append(f"num_threads={threads}")
@@ -84,15 +236,16 @@ def train_reference(cli, workdir, train_path, valid_path, leaves, bins, iters,
     subprocess.run([cli, f"config={conf}"], check=True, cwd=workdir,
                    stdout=subprocess.DEVNULL, stderr=subprocess.STDOUT)
     train_time = time.time() - t0
-    # predict raw scores on train + valid
     preds = {}
-    for tag, path in (("train", train_path), ("valid", valid_path)):
+    paths = {"train": train_path, "valid": valid_path}
+    for tag in predict_on:
         pconf = os.path.join(workdir, f"ref_pred_{tag}.conf")
         out = os.path.join(workdir, f"ref_pred_{tag}.txt")
         with open(pconf, "w") as fh:
             fh.write("\n".join([
-                "task=predict", f"data={path}", f"input_model={model}",
-                f"output_result={out}", "predict_raw_score=false",
+                "task=predict", f"data={paths[tag]}", f"input_model={model}",
+                f"output_result={out}",
+                f"predict_raw_score={'true' if predict_raw else 'false'}",
             ]) + "\n")
         subprocess.run([cli, f"config={pconf}"], check=True, cwd=workdir,
                        stdout=subprocess.DEVNULL, stderr=subprocess.STDOUT)
@@ -132,8 +285,12 @@ def train_tpu(X, y, Xv, yv, leaves, bins, iters, lr):
 
 def main():
     ap = argparse.ArgumentParser()
+    ap.add_argument("--task", default="binary",
+                    choices=["binary", "ranking"])
     ap.add_argument("--rows", type=int, default=1_000_000)
     ap.add_argument("--valid-rows", type=int, default=200_000)
+    ap.add_argument("--features", type=int, default=700,
+                    help="ranking task only (Yahoo shape)")
     ap.add_argument("--iters", type=int, default=500)
     ap.add_argument("--leaves", type=int, default=255)
     ap.add_argument("--bins", type=int, default=63)
@@ -146,6 +303,10 @@ def main():
     ap.add_argument("--skip-ref", action="store_true",
                     help="only run the TPU side (ref numbers must exist)")
     args = ap.parse_args()
+
+    if args.task == "ranking":
+        run_ranking(args)
+        return
 
     os.makedirs(args.workdir, exist_ok=True)
     X, y = synth_higgs(args.rows + args.valid_rows)

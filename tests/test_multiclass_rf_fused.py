@@ -1,0 +1,104 @@
+"""Fused-step coverage for large-k multiclass (scan path) and RF
+(VERDICT r4 weak #4/#5): the single-dispatch fused step must produce the
+same model as the per-tree slow path, for k > 8 and for RF's
+running-average score updates."""
+import numpy as np
+import pytest
+
+import lightgbm_tpu as lgb
+
+
+def _make_multiclass(n=600, f=6, k=20, seed=0):
+    rng = np.random.RandomState(seed)
+    X = rng.random_sample((n, f))
+    centers = rng.random_sample((k, f))
+    y = np.argmin(((X[:, None, :] - centers[None]) ** 2).sum(-1), axis=1)
+    return X, y.astype(np.float64)
+
+
+def _train(X, y, params, n_iter, slow=False):
+    ds = lgb.Dataset(X, label=y, params=params)
+    b = lgb.Booster(params=params, train_set=ds)
+    if slow:
+        b._gbdt._supports_fused = False
+    for _ in range(n_iter):
+        b.update()
+    return b
+
+
+def test_multiclass_k20_fused_equals_slow():
+    X, y = _make_multiclass(k=20)
+    p = {"objective": "multiclass", "num_class": 20, "num_leaves": 7,
+         "min_data_in_leaf": 5, "verbosity": -1}
+    bf = _train(X, y, p, 4)
+    bs = _train(X, y, p, 4, slow=True)
+    assert bf.num_trees() == bs.num_trees() == 80
+    # k=20 really rode the single-dispatch fused step (scan over classes),
+    # not the per-tree dispatch slow path
+    assert hasattr(bf._gbdt, "_step_auto")
+    assert not hasattr(bs._gbdt, "_step_auto")
+    np.testing.assert_allclose(bf.predict(X), bs.predict(X),
+                               rtol=1e-4, atol=1e-6)
+    assert bf.model_to_string() == bs.model_to_string()
+
+
+def test_multiclass_k20_learns():
+    X, y = _make_multiclass(k=20)
+    p = {"objective": "multiclass", "num_class": 20, "num_leaves": 15,
+         "min_data_in_leaf": 5, "learning_rate": 0.2, "verbosity": -1}
+    b = _train(X, y, p, 15)
+    acc = (b.predict(X).argmax(1) == y).mean()
+    assert acc > 0.8, acc
+
+
+def test_rf_fused_equals_slow():
+    rng = np.random.RandomState(3)
+    X = rng.random_sample((500, 5))
+    y = (X[:, 0] + X[:, 1] > 1).astype(np.float64)
+    p = {"objective": "binary", "boosting": "rf", "num_leaves": 15,
+         "bagging_freq": 1, "bagging_fraction": 0.7, "bagging_seed": 7,
+         "min_data_in_leaf": 5, "verbosity": -1}
+    bf = _train(X, y, p, 6)
+    bs = _train(X, y, p, 6, slow=True)
+    assert bf.num_trees() == bs.num_trees() == 6
+    # train scores are running averages in both paths
+    np.testing.assert_allclose(np.asarray(bf.raw_train_score()),
+                               np.asarray(bs.raw_train_score()),
+                               rtol=1e-5, atol=1e-6)
+    assert bf.model_to_string() == bs.model_to_string()
+    np.testing.assert_allclose(bf.predict(X), bs.predict(X),
+                               rtol=1e-5, atol=1e-6)
+
+
+def test_rf_fused_equals_slow_l1_objective():
+    # L1-family objectives implement renew_leaf_values; RF must NOT apply
+    # it on the fused path (its slow path skips _finish_tree renewal)
+    rng = np.random.RandomState(9)
+    X = rng.random_sample((400, 5))
+    y = X[:, 0] * 2 + rng.random_sample(400)
+    p = {"objective": "regression_l1", "boosting": "rf", "num_leaves": 15,
+         "bagging_freq": 1, "bagging_fraction": 0.7, "bagging_seed": 3,
+         "min_data_in_leaf": 5, "verbosity": -1}
+    bf = _train(X, y, p, 5)
+    bs = _train(X, y, p, 5, slow=True)
+    assert bf.model_to_string() == bs.model_to_string()
+    np.testing.assert_allclose(bf.predict(X), bs.predict(X),
+                               rtol=1e-5, atol=1e-6)
+
+
+def test_rf_multiclass_fused_valid_eval():
+    Xall, yall = _make_multiclass(n=600, k=3, seed=4)
+    X, y = Xall[:400], yall[:400]
+    Xv, yv = Xall[400:], yall[400:]
+    p = {"objective": "multiclass", "num_class": 3, "boosting": "rf",
+         "num_leaves": 15, "bagging_freq": 1, "bagging_fraction": 0.7,
+         "min_data_in_leaf": 5, "verbosity": -1, "metric": "multi_logloss"}
+    ds = lgb.Dataset(X, label=y, params=p)
+    b = lgb.Booster(params=p, train_set=ds)
+    b.add_valid(ds.create_valid(Xv, label=yv), "v")
+    for _ in range(8):
+        b.update()
+    (_, _, ll, _) = b.eval_valid()[0]
+    # fused valid scores are maintained as running averages: the logloss of
+    # an averaged 8-tree RF on 3 separable-ish classes must beat random
+    assert ll < np.log(3), ll
