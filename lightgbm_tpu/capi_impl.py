@@ -78,14 +78,11 @@ def save_model(booster, path: str) -> int:
 # LGBM_BoosterUpdateOneIter, c_api.h:215,322,387,482) ----
 
 def _parse_params(params_str: str) -> dict:
-    """Reference parameter-string form: space-separated k=v tokens
-    (Config::Str2Map, config.cpp)."""
-    out = {}
-    for tok in (params_str or "").split():
-        if "=" in tok:
-            k, v = tok.split("=", 1)
-            out[k.strip()] = v.strip()
-    return out
+    """Reference parameter-string form: space-separated k=v tokens — the
+    same Config.str2map the config-file path uses (Config::Str2Map,
+    config.cpp), so comment stripping behaves identically."""
+    from .config import Config
+    return Config.str2map((params_str or "").split())
 
 
 def dataset_from_mat(data_addr: int, nrow: int, ncol: int, params_str: str,
@@ -142,6 +139,29 @@ def booster_add_valid(booster, valid_ds, name: str) -> int:
 
 def booster_update_one_iter(booster) -> int:
     return 1 if booster.update() else 0
+
+
+def booster_get_eval(booster, data_idx: int, out_addr: int, cap: int) -> int:
+    """Metric values for one eval set (reference: LGBM_BoosterGetEval,
+    c_api.h:556): data_idx 0 = training, 1.. = valid sets in add order.
+    Returns the number of doubles written, or -1 on overflow/bad index."""
+    if data_idx == 0:
+        rows = booster.eval_train()
+    else:
+        gb = booster._gbdt
+        names = gb.valid_names if gb else []
+        if not 1 <= data_idx <= len(names):
+            return -1
+        i = data_idx - 1
+        rows = gb.eval_one_set(names[i], gb.valid_scores[i],
+                               gb.valid_sets[i])
+    vals = [float(r[2]) for r in rows]
+    if len(vals) > cap:
+        return -1
+    if vals:
+        buf = (ctypes.c_double * len(vals)).from_address(out_addr)
+        buf[:] = vals
+    return len(vals)
 
 
 def booster_finish_training(booster) -> int:

@@ -173,8 +173,7 @@ class GBDT:
             hist_impl=config.histogram_impl,
             voting_top_k=(config.top_k if config.tree_learner == "voting"
                           else 0),
-            ff_bynode=(config.feature_fraction_bynode
-                       if config.grow_policy == "depthwise" else 1.0),
+            ff_bynode=config.feature_fraction_bynode,
             hist_pool=hist_pool,
             lean_ft=lean_ft,
         )
@@ -184,11 +183,6 @@ class GBDT:
                 "(10-24x slower; see docs/PERF_NOTES.md) and its "
                 "implementation is archived on branch archive/packed-levels; "
                 "the flag is ignored")
-        if (config.feature_fraction_bynode < 1.0
-                and config.grow_policy != "depthwise"):
-            log.warning("feature_fraction_bynode is only implemented for the "
-                        "depthwise grower; ignoring for grow_policy="
-                        f"{config.grow_policy}")
         if (config.tree_learner == "voting"
                 and config.grow_policy != "depthwise"):
             log.warning("tree_learner=voting is only implemented for the "
@@ -264,9 +258,13 @@ class GBDT:
                 self._bundle_dev, self._fmesh)
             log.info(f"feature-parallel tree learner over "
                      f"{self._fmesh.devices.size} devices")
-        if self._cegb_dev is not None and (self._dp or self._fp):
-            log.warning("CEGB is not supported with distributed tree "
-                        "learners; ignoring cegb_* parameters")
+        if self._cegb_dev is not None and self._fp:
+            # feature-parallel shards the FEATURE axis; the per-feature
+            # penalty/used vectors would need feature sharding + allgathered
+            # election bookkeeping — not implemented (the data-parallel
+            # learner supports CEGB: rows shard, penalties replicate)
+            log.warning("CEGB is not supported with the feature-parallel "
+                        "tree learner; ignoring cegb_* parameters")
             self._cegb_dev = None
         if self._dp:
             from ..parallel.mesh import make_mesh, pad_rows_to_devices, shard_rows
@@ -276,6 +274,15 @@ class GBDT:
             padded, self._n_orig = pad_rows_to_devices(bins_np, nd)
             self._bins_dp = shard_rows(jnp.asarray(padded), self._mesh)
             self._pad_rows = padded.shape[0] - self._n_orig
+            if (self._cegb_dev is not None
+                    and self._cegb_dev.data_used.shape[0] > 1):
+                # lazy bitset rows pad + shard with the data (padded rows
+                # never pay: their count channel is zero)
+                du = self._cegb_dev.data_used
+                if self._pad_rows:
+                    du = jnp.pad(du, ((0, self._pad_rows), (0, 0)))
+                self._cegb_dev = self._cegb_dev._replace(
+                    data_used=shard_rows(du, self._mesh))
             log.info(f"data-parallel tree learner over {nd} devices")
 
     def _cegb_setup(self, config, train_set):
@@ -358,10 +365,6 @@ class GBDT:
         ForceSplits, serial_tree_learner.cpp:456-618; config.h
         forcedsplits_filename)."""
         if not config.forcedsplits_filename:
-            return None
-        if config.grow_policy != "depthwise":
-            log.warning("forced splits are only supported by the depthwise "
-                        "grower; ignoring forcedsplits_filename")
             return None
         import json as _json
         with open(config.forcedsplits_filename) as fh:
@@ -589,36 +592,77 @@ class GBDT:
         if self._dp:
             import dataclasses
             from jax.sharding import PartitionSpec as PS
+            from ..ops.grow_depthwise import CEGBState
             mesh = self._mesh
             axis = mesh.axis_names[0]
             gp_grow = dataclasses.replace(gp, axis_name=axis)
             pad_rows, n_orig = self._pad_rows, self._n_orig
+            # CEGB under the data-parallel learner (VERDICT r4 weak #6):
+            # the per-(row, feature) lazy bitset shards over rows with the
+            # data; feature_used and the penalty vectors stay replicated
+            # (split selection is replicated), and the grower's lazy-cost
+            # aggregation is already psum'd under gp.axis_name — matching
+            # the reference's learner-agnostic CEGB hook
+            # (serial_tree_learner.cpp:756-759)
+            if use_cegb:
+                cegb_lazy_rows = self._cegb_dev.data_used.shape[0] > 1
+                cegb_spec = CEGBState(
+                    feature_used=PS(),
+                    data_used=PS(axis, None) if cegb_lazy_rows else PS(),
+                    coupled_pen=PS(), lazy_pen=PS())
 
-            def _grow_shard(b_, g_, h_, c_, nb_, na_, fm_, qs_):
-                kw2 = ({"qseed": qs_}
-                       if (depthwise_fused and (gp_grow.quant
-                                                or gp_grow.ff_bynode < 1.0))
-                       else {})
-                return grow_fn(b_, g_, h_, c_, nb_, na_, fm_, gp_grow,
-                               bundle=bundle, **kw2)
+                def _grow_shard(b_, g_, h_, c_, nb_, na_, fm_, qs_, cegb_):
+                    kw2 = ({"qseed": qs_}
+                           if ((depthwise_fused and gp_grow.quant)
+                               or gp_grow.ff_bynode < 1.0)
+                           else {})
+                    return grow_fn(b_, g_, h_, c_, nb_, na_, fm_, gp_grow,
+                                   bundle=bundle, cegb=cegb_, **kw2)
 
-            grow_sm = jax.shard_map(
-                _grow_shard, mesh=mesh,
-                in_specs=(PS(axis, None), PS(axis), PS(axis), PS(axis),
-                          PS(), PS(), PS(), PS()),
-                out_specs=(TreeArrays(*([PS()] * len(TreeArrays._fields))),
-                           PS(axis)),
-                check_vma=False)
+                grow_sm = jax.shard_map(
+                    _grow_shard, mesh=mesh,
+                    in_specs=(PS(axis, None), PS(axis), PS(axis), PS(axis),
+                              PS(), PS(), PS(), PS(), cegb_spec),
+                    out_specs=(TreeArrays(*([PS()] * len(TreeArrays._fields))),
+                               PS(axis), cegb_spec),
+                    check_vma=False)
 
-            def do_grow(bins, gw, hw, cw, num_bins, na_bin, fmask, qs,
-                        cegb_st):
-                if pad_rows:
-                    gw = jnp.pad(gw, (0, pad_rows))
-                    hw = jnp.pad(hw, (0, pad_rows))
-                    cw = jnp.pad(cw, (0, pad_rows))
-                tree, leaf_id = grow_sm(bins, gw, hw, cw, num_bins, na_bin,
-                                        fmask, qs)
-                return tree, leaf_id[:n_orig], cegb_st
+                def do_grow(bins, gw, hw, cw, num_bins, na_bin, fmask, qs,
+                            cegb_st):
+                    if pad_rows:
+                        gw = jnp.pad(gw, (0, pad_rows))
+                        hw = jnp.pad(hw, (0, pad_rows))
+                        cw = jnp.pad(cw, (0, pad_rows))
+                    tree, leaf_id, cegb_st = grow_sm(
+                        bins, gw, hw, cw, num_bins, na_bin, fmask, qs,
+                        cegb_st)
+                    return tree, leaf_id[:n_orig], cegb_st
+            else:
+                def _grow_shard(b_, g_, h_, c_, nb_, na_, fm_, qs_):
+                    kw2 = ({"qseed": qs_}
+                           if ((depthwise_fused and gp_grow.quant)
+                               or gp_grow.ff_bynode < 1.0)
+                           else {})
+                    return grow_fn(b_, g_, h_, c_, nb_, na_, fm_, gp_grow,
+                                   bundle=bundle, **kw2)
+
+                grow_sm = jax.shard_map(
+                    _grow_shard, mesh=mesh,
+                    in_specs=(PS(axis, None), PS(axis), PS(axis), PS(axis),
+                              PS(), PS(), PS(), PS()),
+                    out_specs=(TreeArrays(*([PS()] * len(TreeArrays._fields))),
+                               PS(axis)),
+                    check_vma=False)
+
+                def do_grow(bins, gw, hw, cw, num_bins, na_bin, fmask, qs,
+                            cegb_st):
+                    if pad_rows:
+                        gw = jnp.pad(gw, (0, pad_rows))
+                        hw = jnp.pad(hw, (0, pad_rows))
+                        cw = jnp.pad(cw, (0, pad_rows))
+                    tree, leaf_id = grow_sm(bins, gw, hw, cw, num_bins,
+                                            na_bin, fmask, qs)
+                    return tree, leaf_id[:n_orig], cegb_st
         elif self._fp:
             # feature-parallel shards features, so the per-shard frontier is
             # already width-bounded — lean mode is gated off in the pool
@@ -639,9 +683,8 @@ class GBDT:
         else:
             def do_grow(bins, gw, hw, cw, num_bins, na_bin, fmask, qs,
                         cegb_st):
-                kw = {"forced": forced} if (depthwise_fused and
-                                             forced is not None) else {}
-                if depthwise_fused and (gp.quant or gp.ff_bynode < 1.0):
+                kw = {"forced": forced} if forced is not None else {}
+                if (depthwise_fused and gp.quant) or gp.ff_bynode < 1.0:
                     kw["qseed"] = qs
                 if use_cegb:
                     # CEGB bookkeeping threads across the k class trees of one
@@ -886,7 +929,9 @@ class GBDT:
             q.clear()
 
     def _update_valid_scores(self, tree_dev, cls: int, bias: float = 0.0) -> None:
-        k = self.num_tree_per_iteration
+        """Route each valid set through the finished tree and fold the
+        delta in via _apply_valid_delta (additive here; RF overrides with
+        its running average)."""
         max_steps = self.gp.num_leaves - 1 if self.gp.num_leaves > 1 else 1
         for i, vs in enumerate(self.valid_sets):
             leaf = P.route_bins(
@@ -894,10 +939,13 @@ class GBDT:
                 tree_dev.default_left, tree_dev.left_child, tree_dev.right_child,
                 tree_dev.num_leaves, vs.bins, vs.na_bin_dev, max_steps)
             vdelta = take_small(tree_dev.leaf_value, leaf) - bias
-            if k == 1:
-                self.valid_scores[i] = self.valid_scores[i] + vdelta
-            else:
-                self.valid_scores[i] = self.valid_scores[i].at[:, cls].add(vdelta)
+            self.valid_scores[i] = self._apply_valid_delta(
+                self.valid_scores[i], vdelta, cls)
+
+    def _apply_valid_delta(self, score, vdelta, cls: int):
+        if self.num_tree_per_iteration == 1:
+            return score + vdelta
+        return score.at[:, cls].add(vdelta)
 
     def _grow_and_update_slow(self, grad, hess) -> bool:
         k = self.num_tree_per_iteration
@@ -948,10 +996,14 @@ class GBDT:
                         fmask, self.gp, bundle=self._bundle_dev,
                         forced=self._forced_dev, **qkw)
             else:
+                qkw2 = ({"qseed": jnp.int32(self.iter_ * k + cls)}
+                        if self.gp.ff_bynode < 1.0 else {})
                 tree_dev, leaf_id = grow_tree(ts.bins, gw, hw, cw,
                                               ts.num_bins_dev, ts.na_bin_dev,
                                               fmask, self.gp,
-                                              bundle=self._bundle_dev)
+                                              bundle=self._bundle_dev,
+                                              forced=self._forced_dev,
+                                              **qkw2)
             tree_dev = self._finish_tree(tree_dev, leaf_id, cls)
             self.models_dev.append(tree_dev)
             self._update_scores(tree_dev, leaf_id, cls)

@@ -70,35 +70,16 @@ class RF(GBDT):
         import jax
         return jax.lax.dynamic_update_index_in_dim(score, col, cls, 1)
 
-    def _update_valid_scores(self, tree_dev, cls: int, bias: float = 0.0) -> None:
-        """Fused-path valid-score maintenance: running average, not additive."""
-        k = self.num_tree_per_iteration
-        t = self.iter_ + 1
-        from ..ops import predict as P
-        max_steps = self.gp.num_leaves - 1 if self.gp.num_leaves > 1 else 1
-        for i, vs in enumerate(self.valid_sets):
-            leaf = P.route_bins(
-                tree_dev.split_feature, tree_dev.threshold_bin,
-                tree_dev.default_left, tree_dev.left_child, tree_dev.right_child,
-                tree_dev.num_leaves, vs.bins, vs.na_bin_dev, max_steps)
-            vdelta = take_small(tree_dev.leaf_value, leaf)
-            if k == 1:
-                self.valid_scores[i] = (self.valid_scores[i] * (t - 1)
-                                        + vdelta) / t
-            else:
-                prev = self.valid_scores[i][:, cls] * (t - 1)
-                self.valid_scores[i] = self.valid_scores[i].at[:, cls].set(
-                    (prev + vdelta) / t)
+    def _apply_valid_delta(self, score, vdelta, cls: int):
+        """Valid scores are running averages too (rf.hpp TrainOneIter)."""
+        return self._apply_tree_delta(score, vdelta, cls,
+                                      float(self.iter_ + 1))
 
     def _update_scores(self, tree_dev, leaf_id, cls) -> None:
-        """Maintain scores as running averages (rf.hpp TrainOneIter);
-        valid sets share the fused path's averaging update."""
-        k = self.num_tree_per_iteration
-        t = self.iter_ + 1  # trees per class after this one
+        """Maintain scores as running averages (rf.hpp TrainOneIter) via the
+        same _apply_tree_delta hook the fused step uses; valid sets share
+        the fused path's averaging update."""
         delta = take_small(tree_dev.leaf_value, leaf_id)
-        if k == 1:
-            self.train_score = (self.train_score * (t - 1) + delta) / t
-        else:
-            prev = self.train_score[:, cls] * (t - 1)
-            self.train_score = self.train_score.at[:, cls].set((prev + delta) / t)
+        self.train_score = self._apply_tree_delta(
+            self.train_score, delta, cls, float(self.iter_ + 1))
         self._update_valid_scores(tree_dev, cls)

@@ -326,6 +326,33 @@ int LGBMTPU_BoosterAddValidData(void* booster, void* valid_dataset,
   return 0;
 }
 
+// Metric values on one eval set (reference: LGBM_BoosterGetEval,
+// c_api.h:556): data_idx 0 = training set, 1.. = valid sets in AddValidData
+// order. out receives up to cap doubles; *out_len = metrics written.
+// Enables a pure-C host to drive early stopping around UpdateOneIter.
+int LGBMTPU_BoosterGetEval(void* booster, int data_idx, double* out,
+                           int cap, int* out_len) {
+  ensure_interpreter();
+  GilGuard gil;
+  if (ensure_impl() != 0) return -1;
+  PyObject* r = PyObject_CallMethod(
+      g_impl, "booster_get_eval", "OiLi",
+      static_cast<PyObject*>(booster), data_idx,
+      static_cast<long long>(reinterpret_cast<intptr_t>(out)), cap);
+  if (r == nullptr) {
+    capture_py_error();
+    return -1;
+  }
+  long n = PyLong_AsLong(r);
+  Py_DECREF(r);
+  if (n < 0) {
+    set_error("output buffer too small or bad data_idx");
+    return -1;
+  }
+  *out_len = static_cast<int>(n);
+  return 0;
+}
+
 // Signal the end of the update loop: flushes the lagged finished-check
 // queue so trailing single-leaf stump iterations are dropped (the Python
 // engine calls finish_training at loop end; a fixed-iteration C host must

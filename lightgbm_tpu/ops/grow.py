@@ -117,6 +117,7 @@ class _GrowState(NamedTuple):
     parent_right: jnp.ndarray    # [L] bool
     leaf_min: jnp.ndarray        # [L] monotone output bounds
     leaf_max: jnp.ndarray
+    forced_ptr: jnp.ndarray      # [L] i32: forced node to apply (-1 none)
     best: SplitResult            # arrays [L]
     tree: TreeArrays
     done: jnp.ndarray            # scalar bool
@@ -146,7 +147,8 @@ def _allow_depth(depth, gp: GrowParams):
 @partial(jax.jit, static_argnames=("gp",))
 def grow_tree(bins: jnp.ndarray, g: jnp.ndarray, h: jnp.ndarray, c: jnp.ndarray,
               num_bins: jnp.ndarray, na_bin: jnp.ndarray,
-              feature_mask: jnp.ndarray, gp: GrowParams, bundle=None
+              feature_mask: jnp.ndarray, gp: GrowParams, bundle=None,
+              forced=None, qseed=None
               ) -> Tuple[TreeArrays, jnp.ndarray]:
     """Grow one tree.
 
@@ -158,10 +160,31 @@ def grow_tree(bins: jnp.ndarray, g: jnp.ndarray, h: jnp.ndarray, c: jnp.ndarray,
 
     Returns (TreeArrays, leaf_id [N] i32). leaf_id routes *all* rows (including
     out-of-bag) so the caller can update train scores by a single gather.
+
+    ``forced`` (a grow_depthwise.ForcedSplits) applies the forced-splits
+    tree leaf-wise: a leaf holding a forced-node pointer splits on that
+    (feature, bin) with gain overridden high, mirroring the reference's
+    ForceSplits-before-normal-growth (serial_tree_learner.cpp:456-618).
+    Forced mode keeps the full [L] histogram state (the pool's evicted
+    parents could not provide the forced split's cumsum). ``qseed`` drives
+    per-node feature sampling when gp.ff_bynode < 1.
     """
     n, f = bins.shape
     L, B = gp.num_leaves, gp.max_bin
     sp = gp.split
+
+    def _node_mask(tag, base_mask):
+        """feature_fraction_bynode: Bernoulli keep within the usable set,
+        best-u always kept so no node searches nothing (same scheme as the
+        depthwise grower, keyed on (tree seed, split index))."""
+        if gp.ff_bynode >= 1.0:
+            return base_mask
+        seed_base = qseed if qseed is not None else jnp.int32(0)
+        key = jax.random.fold_in(jax.random.PRNGKey(seed_base), tag)
+        u = jax.random.uniform(key, base_mask.shape)
+        u_allowed = jnp.where(base_mask, u, -1.0)
+        best_u = u_allowed >= u_allowed.max(axis=-1, keepdims=True)
+        return base_mask & ((u < gp.ff_bynode) | best_u)
 
     leaf_id = jnp.zeros(n, dtype=jnp.int32)
     # pallas kernels read a transposed bin matrix; build it ONCE per tree (XLA
@@ -171,7 +194,9 @@ def grow_tree(bins: jnp.ndarray, g: jnp.ndarray, h: jnp.ndarray, c: jnp.ndarray,
                   gp)                                                  # [3, F, B]
     g0, h0, c0 = hist0[0, 0].sum(), hist0[1, 0].sum(), hist0[2, 0].sum()
 
-    best0 = best_split(hist0, num_bins, na_bin, g0, h0, c0, feature_mask, sp,
+    best0 = best_split(hist0, num_bins, na_bin, g0, h0, c0,
+                       _node_mask(L, feature_mask), sp,   # tag L: root (child
+                       # tags are the split steps 0..L-2; fold_in rejects -1)
                        allow_split=_allow_depth(jnp.int32(0), gp) if gp.max_depth > 0 else True,
                        bundle=bundle)
 
@@ -187,8 +212,9 @@ def grow_tree(bins: jnp.ndarray, g: jnp.ndarray, h: jnp.ndarray, c: jnp.ndarray,
         cat_member=jnp.zeros((L, B), dtype=bool).at[0].set(best0.cat_member))
 
     # HistogramPool (reference: feature_histogram.hpp:687): cap the cached
-    # leaf histograms at P slots; evicted parents rebuild with a masked pass
-    P = gp.hist_pool if 0 < gp.hist_pool < L else L
+    # leaf histograms at P slots; evicted parents rebuild with a masked pass.
+    # Forced mode keeps everything resident (see docstring)
+    P = gp.hist_pool if 0 < gp.hist_pool < L and forced is None else L
     pooled = P < L
     hist = jnp.zeros((P, 3, f, B), dtype=jnp.float32).at[0].set(hist0)
     if pooled:
@@ -211,18 +237,54 @@ def grow_tree(bins: jnp.ndarray, g: jnp.ndarray, h: jnp.ndarray, c: jnp.ndarray,
         parent_right=jnp.zeros(L, dtype=bool),
         leaf_min=jnp.full(L, -jnp.inf),
         leaf_max=jnp.full(L, jnp.inf),
+        forced_ptr=jnp.full(L, -1, jnp.int32).at[0].set(
+            0 if forced is not None else -1),
         best=best, tree=_empty_tree(L, B), done=jnp.bool_(L < 2),
     )
 
     def step(st: _GrowState, t):
-        l = jnp.argmax(st.best.gain).astype(jnp.int32)
-        ok = (st.best.gain[l] > NEG_INF / 2) & (~st.done)
+        best_eff = st.best
+        if forced is not None:
+            # leaf-wise ForceSplits: leaves holding a forced-node pointer get
+            # their gain overridden high so argmax picks the lowest such leaf
+            # first; left stats come from the leaf histogram's cumsum at the
+            # forced bin (na bin excluded), exactly like the depthwise grower
+            fp = jnp.maximum(st.forced_ptr, 0)
+            has_f = st.forced_ptr >= 0
+            ffeat = forced.feat[fp]                          # [L]
+            fbin = forced.bin[fp]
+            iota_bf = jnp.arange(B, dtype=jnp.int32)[None, None, :]
+            na_self = iota_bf == na_bin[None, :, None]       # [1, F, B]
+            cumf = jnp.cumsum(jnp.where(na_self[:, None], 0.0, st.hist),
+                              axis=-1)                       # [L, 3, F, B]
+            lidx2 = jnp.arange(L)
+            flg = cumf[lidx2, 0, ffeat, fbin]
+            flh = cumf[lidx2, 1, ffeat, fbin]
+            flc = cumf[lidx2, 2, ffeat, fbin]
+            okf = has_f & (flc >= 1) & (st.leaf_cnt - flc >= 1)
+            big = jnp.float32(1e30)
+            best_eff = st.best._replace(
+                gain=jnp.where(okf, big, st.best.gain),
+                feature=jnp.where(okf, ffeat, st.best.feature),
+                bin=jnp.where(okf, fbin, st.best.bin),
+                default_left=jnp.where(okf, False, st.best.default_left),
+                left_g=jnp.where(okf, flg, st.best.left_g),
+                left_h=jnp.where(okf, flh, st.best.left_h),
+                left_cnt=jnp.where(okf, flc, st.best.left_cnt),
+                is_cat=jnp.where(okf, False, st.best.is_cat),
+                cat_member=jnp.where(okf[:, None], False,
+                                     st.best.cat_member))
+            # degenerate forced splits stop forcing at that leaf
+            st = st._replace(forced_ptr=jnp.where(has_f & ~okf, -1,
+                                                  st.forced_ptr))
+        l = jnp.argmax(best_eff.gain).astype(jnp.int32)
+        ok = (best_eff.gain[l] > NEG_INF / 2) & (~st.done)
 
         def do_split(st: _GrowState) -> _GrowState:
             new_leaf = t + 1
-            feat = st.best.feature[l]
-            thr = st.best.bin[l]
-            dleft = st.best.default_left[l]
+            feat = best_eff.feature[l]
+            thr = best_eff.bin[l]
+            dleft = best_eff.default_left[l]
 
             # ---- partition rows (reference: DataPartition::Split,
             # data_partition.hpp:113 — here a vectorized where on leaf_id) ----
@@ -231,15 +293,16 @@ def grow_tree(bins: jnp.ndarray, g: jnp.ndarray, h: jnp.ndarray, c: jnp.ndarray,
             go_right = jnp.where(is_na, ~dleft, col > thr)
             if sp.cat_features or sp.has_bundles:
                 from .gather import take_small
-                iscat = st.best.is_cat[l]
-                memrow = st.best.cat_member[l].astype(jnp.float32)
+                iscat = best_eff.is_cat[l]
+                memrow = best_eff.cat_member[l].astype(jnp.float32)
                 mem = take_small(memrow, col) > 0.5
                 go_right = jnp.where(iscat, ~mem, go_right)
             in_leaf = st.leaf_id == l
             leaf_id2 = jnp.where(in_leaf & go_right, new_leaf, st.leaf_id)
 
             # ---- child stats ----
-            lg, lh, lc = st.best.left_g[l], st.best.left_h[l], st.best.left_cnt[l]
+            lg, lh, lc = (best_eff.left_g[l], best_eff.left_h[l],
+                          best_eff.left_cnt[l])
             pg, ph, pc = st.leaf_g[l], st.leaf_h[l], st.leaf_cnt[l]
             rg, rh, rc = pg - lg, ph - lh, pc - lc
             lmin_p, lmax_p = st.leaf_min[l], st.leaf_max[l]
@@ -321,7 +384,7 @@ def grow_tree(bins: jnp.ndarray, g: jnp.ndarray, h: jnp.ndarray, c: jnp.ndarray,
                 default_left=tr.default_left.at[t].set(dleft),
                 left_child=lc_arr.at[t].set(~l),
                 right_child=rc_arr.at[t].set(~new_leaf),
-                split_gain=tr.split_gain.at[t].set(st.best.gain[l]),
+                split_gain=tr.split_gain.at[t].set(best_eff.gain[l]),
                 leaf_value=tr.leaf_value.at[l].set(w_l).at[new_leaf].set(w_r),
                 leaf_weight=tr.leaf_weight.at[l].set(lh).at[new_leaf].set(rh),
                 leaf_count=tr.leaf_count.at[l].set(lc).at[new_leaf].set(rc),
@@ -329,8 +392,8 @@ def grow_tree(bins: jnp.ndarray, g: jnp.ndarray, h: jnp.ndarray, c: jnp.ndarray,
                 internal_weight=tr.internal_weight.at[t].set(ph),
                 internal_count=tr.internal_count.at[t].set(pc),
                 num_leaves=tr.num_leaves + 1,
-                is_cat=tr.is_cat.at[t].set(st.best.is_cat[l]),
-                cat_mask=tr.cat_mask.at[t].set(st.best.cat_member[l]),
+                is_cat=tr.is_cat.at[t].set(best_eff.is_cat[l]),
+                cat_mask=tr.cat_mask.at[t].set(best_eff.cat_member[l]),
             )
 
             # ---- monotone bound propagation for the two children ----
@@ -338,7 +401,7 @@ def grow_tree(bins: jnp.ndarray, g: jnp.ndarray, h: jnp.ndarray, c: jnp.ndarray,
                 mono_tab = jnp.zeros(f, jnp.int32).at[
                     jnp.arange(len(sp.monotone_constraints[:f]))].set(
                     jnp.asarray(sp.monotone_constraints[:f], jnp.int32))
-                mf = jnp.where(st.best.is_cat[l], 0, mono_tab[feat])
+                mf = jnp.where(best_eff.is_cat[l], 0, mono_tab[feat])
                 mid = (w_l + w_r) / 2.0
                 lmin_l = jnp.where(mf < 0, jnp.maximum(lmin_p, mid), lmin_p)
                 lmax_l = jnp.where(mf > 0, jnp.minimum(lmax_p, mid), lmax_p)
@@ -352,6 +415,17 @@ def grow_tree(bins: jnp.ndarray, g: jnp.ndarray, h: jnp.ndarray, c: jnp.ndarray,
                 ch_min = ch_max = None
                 leaf_min2, leaf_max2 = st.leaf_min, st.leaf_max
 
+            # ---- forced-pointer propagation to the two children ----
+            if forced is not None:
+                applied = st.forced_ptr[l] >= 0
+                fnode = jnp.maximum(st.forced_ptr[l], 0)
+                fl_next = jnp.where(applied, forced.left[fnode], -1)
+                fr_next = jnp.where(applied, forced.right[fnode], -1)
+                fptr2 = st.forced_ptr.at[l].set(fl_next) \
+                                     .at[new_leaf].set(fr_next)
+            else:
+                fptr2 = st.forced_ptr
+
             # ---- best splits for the two children (batched, not vmapped) ----
             depth = st.leaf_depth[l] + 1
             allow = _allow_depth(depth, gp) if gp.max_depth > 0 else jnp.bool_(True)
@@ -359,8 +433,10 @@ def grow_tree(bins: jnp.ndarray, g: jnp.ndarray, h: jnp.ndarray, c: jnp.ndarray,
             ch_g = jnp.stack([lg, rg])
             ch_h = jnp.stack([lh, rh])
             ch_c = jnp.stack([lc, rc])
+            ch_mask = _node_mask(
+                t, jnp.broadcast_to(feature_mask, (2, f)))
             bs = best_split(ch_hist, num_bins, na_bin, ch_g, ch_h, ch_c,
-                            feature_mask, sp, allow,
+                            ch_mask, sp, allow,
                             leaf_min=ch_min, leaf_max=ch_max, bundle=bundle)
 
             def upd(arr, vals):
@@ -378,7 +454,8 @@ def grow_tree(bins: jnp.ndarray, g: jnp.ndarray, h: jnp.ndarray, c: jnp.ndarray,
                 parent_node=st.parent_node.at[l].set(t).at[new_leaf].set(t),
                 parent_right=st.parent_right.at[l].set(False).at[new_leaf].set(True),
                 leaf_min=leaf_min2, leaf_max=leaf_max2,
-                best=best2, tree=tr, done=st.done,
+                forced_ptr=fptr2, tree=tr, done=st.done,
+                best=best2,
             )
 
         st2 = jax.lax.cond(ok, do_split, lambda s: s, st)

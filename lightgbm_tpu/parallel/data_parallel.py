@@ -39,10 +39,11 @@ def grow_tree_dp(bins, g, h, c, num_bins, na_bin, feature_mask,
     gp_dp = gp if gp.axis_name == axis else \
         dataclasses.replace(gp, axis_name=axis)
 
-    if gp_dp.quant:
-        # thread the stochastic-rounding seed as an explicit replicated
-        # operand (a closed-over tracer is illegal under shard_map) so the
-        # dither varies per iteration on the dp path too
+    if gp_dp.quant or gp_dp.ff_bynode < 1.0:
+        # thread the stochastic-rounding / per-node-sampling seed as an
+        # explicit replicated operand (a closed-over tracer is illegal under
+        # shard_map) so the dither and feature subsets vary per tree on the
+        # dp path too
         def _fn(b_, g_, h_, c_, nb_, na_, fm_, qs_):
             return grow_fn(b_, g_, h_, c_, nb_, na_, fm_, gp=gp_dp,
                            bundle=bundle, qseed=qs_)

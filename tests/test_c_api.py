@@ -306,3 +306,51 @@ int main(int argc, char** argv) {
     Xp = rng.random_sample((100, 4))
     pred = loaded.predict(Xp)
     assert ((pred > 0.5) == (Xp[:, 0] > 0.5)).mean() > 0.8
+
+
+def test_c_api_get_eval(capi):
+    """LGBMTPU_BoosterGetEval: metric readback for stepwise C-host early
+    stopping (reference: LGBM_BoosterGetEval, c_api.h:556)."""
+    _bind_dataset_fns(capi)
+    capi.LGBMTPU_BoosterGetEval.argtypes = [
+        ctypes.c_void_p, ctypes.c_int, ctypes.POINTER(ctypes.c_double),
+        ctypes.c_int, ctypes.POINTER(ctypes.c_int)]
+    rng = np.random.RandomState(11)
+    X = np.ascontiguousarray(rng.randn(300, 4), dtype=np.float64)
+    y = (X[:, 0] > 0).astype(np.float64)
+    Xv = np.ascontiguousarray(rng.randn(120, 4), dtype=np.float64)
+    yv = (Xv[:, 0] > 0).astype(np.float64)
+    params = b"objective=binary num_leaves=7 min_data_in_leaf=5 metric=auc verbosity=-1"
+    d = ctypes.c_void_p()
+    assert capi.LGBMTPU_DatasetCreateFromMat(
+        X.ctypes.data_as(ctypes.POINTER(ctypes.c_double)), 300, 4, params,
+        None, ctypes.byref(d)) == 0
+    assert capi.LGBMTPU_DatasetSetField(d, b"label", y.ctypes.data, 300, 0) == 0
+    b = ctypes.c_void_p()
+    assert capi.LGBMTPU_BoosterCreate(d, params, ctypes.byref(b)) == 0
+    dv = ctypes.c_void_p()
+    assert capi.LGBMTPU_DatasetCreateFromMat(
+        Xv.ctypes.data_as(ctypes.POINTER(ctypes.c_double)), 120, 4, params,
+        d, ctypes.byref(dv)) == 0
+    assert capi.LGBMTPU_DatasetSetField(dv, b"label", yv.ctypes.data,
+                                        120, 0) == 0
+    assert capi.LGBMTPU_BoosterAddValidData(b, dv, b"v0") == 0, \
+        capi.LGBMTPU_GetLastError()
+    fin = ctypes.c_int()
+    for _ in range(5):
+        assert capi.LGBMTPU_BoosterUpdateOneIter(b, ctypes.byref(fin)) == 0
+    out = np.zeros(4, dtype=np.float64)
+    n = ctypes.c_int()
+    rc = capi.LGBMTPU_BoosterGetEval(
+        b, 1, out.ctypes.data_as(ctypes.POINTER(ctypes.c_double)), 4,
+        ctypes.byref(n))
+    assert rc == 0, capi.LGBMTPU_GetLastError()
+    assert n.value == 1
+    assert 0.5 < out[0] <= 1.0          # valid AUC on a separable rule
+    # bad index errors cleanly
+    assert capi.LGBMTPU_BoosterGetEval(
+        b, 9, out.ctypes.data_as(ctypes.POINTER(ctypes.c_double)), 4,
+        ctypes.byref(n)) == -1
+    capi.LGBMTPU_BoosterFree(b)
+    capi.LGBMTPU_DatasetFree(dv)
+    capi.LGBMTPU_DatasetFree(d)
