@@ -638,6 +638,31 @@ class Dataset:
     def feature_names(self) -> List[str]:
         return list(self._names)
 
+    def get_feature_penalty(self):
+        """Per-feature gain penalty, or None (reference:
+        Dataset.get_feature_penalty, basic.py:1484 — the feature_contri /
+        feature_penalty parameter)."""
+        v = params_to_config(self.params).feature_contri
+        return np.asarray(v, dtype=np.float64) if v else None
+
+    def get_monotone_constraints(self):
+        """Per-feature monotone constraints (-1/0/1), or None (reference:
+        Dataset.get_monotone_constraints, basic.py:1496)."""
+        v = params_to_config(self.params).monotone_constraints
+        return np.asarray(v, dtype=np.int8) if v else None
+
+    @staticmethod
+    def _merge_per_feature_param(a, b, na: int, nb: int, default):
+        """Concatenate two per-feature parameter vectors for
+        add_features_from; a missing side takes the parameter's neutral
+        default (reference: LGBM_DatasetAddFeaturesFrom merges
+        feature_penalty with 1s and monotone_constraints with 0s)."""
+        if a is None and b is None:
+            return None
+        av = list(a) if a is not None else [default] * na
+        bv = list(b) if b is not None else [default] * nb
+        return av + bv
+
     def add_features_from(self, other: "Dataset") -> "Dataset":
         """Append ``other``'s features to this Dataset (reference:
         Dataset::AddFeaturesFrom, src/io/dataset.cpp:1385, exposed as
@@ -680,8 +705,25 @@ class Dataset:
         self.missing_type_dev = jax.device_put(self._mtypes_np)
         self.max_num_bins = max(self.max_num_bins, other.max_num_bins)
         self._names = list(self._names) + list(other._names)
-        self._num_features_raw = (int(self._num_features_raw or 0)
-                                  + int(other._num_features_raw or 0))
+        na = int(self._num_features_raw or 0)
+        nb = int(other._num_features_raw or 0)
+        pen = self._merge_per_feature_param(
+            self.get_feature_penalty(), other.get_feature_penalty(),
+            na, nb, 1.0)
+        if pen is not None:
+            # drop alias spellings or the stale pre-merge value wins
+            # alias resolution over the canonical key
+            for alias in ("feature_contrib", "fc", "fp", "feature_penalty"):
+                self.params.pop(alias, None)
+            self.params["feature_contri"] = [float(v) for v in pen]
+        mono = self._merge_per_feature_param(
+            self.get_monotone_constraints(),
+            other.get_monotone_constraints(), na, nb, 0)
+        if mono is not None:
+            for alias in ("mc", "monotone_constraint"):
+                self.params.pop(alias, None)
+            self.params["monotone_constraints"] = [int(v) for v in mono]
+        self._num_features_raw = na + nb
         return self
 
 
@@ -1138,6 +1180,107 @@ class Booster:
                 return pd.DataFrame(ret, columns=["SplitValue", "Count"])
             return ret
         return hist, edges
+
+    def trees_to_dataframe(self):
+        """Parse the fitted model into a pandas DataFrame, one row per node
+        (reference: Booster.trees_to_dataframe, basic.py:1865 — same
+        columns and 'tree-S<i>' / 'tree-L<i>' node-index scheme)."""
+        if not _PANDAS:
+            log.fatal("This method cannot be run without pandas installed")
+        if self.num_trees() == 0:
+            log.fatal("There are no trees in this Booster and thus nothing "
+                      "to parse")
+        model = self.dump_model()
+        feature_names = model.get("feature_names") or None
+        rows: List[Dict[str, Any]] = []
+
+        def node_index(tree_index, node):
+            is_split = "split_index" in node
+            tag = "S" if is_split else "L"
+            num = node.get("split_index" if is_split else "leaf_index", 0)
+            return f"{tree_index}-{tag}{num}"
+
+        def rec(node, tree_index, depth, parent):
+            is_split = "split_index" in node
+            row = {
+                "tree_index": tree_index,
+                "node_depth": depth,
+                "node_index": node_index(tree_index, node),
+                "left_child": None, "right_child": None,
+                "parent_index": parent,
+                "split_feature": None, "split_gain": None,
+                "threshold": None, "decision_type": None,
+                "missing_direction": None, "missing_type": None,
+                "value": None, "weight": None, "count": None,
+            }
+            if is_split:
+                row["left_child"] = node_index(tree_index, node["left_child"])
+                row["right_child"] = node_index(tree_index,
+                                                node["right_child"])
+                sf = node["split_feature"]
+                row["split_feature"] = (feature_names[sf] if feature_names
+                                        else sf)
+                row["split_gain"] = node["split_gain"]
+                row["threshold"] = node["threshold"]
+                row["decision_type"] = node["decision_type"]
+                row["missing_direction"] = ("left" if node["default_left"]
+                                            else "right")
+                row["missing_type"] = node["missing_type"]
+                row["value"] = node["internal_value"]
+                row["weight"] = node["internal_weight"]
+                row["count"] = node["internal_count"]
+                rows.append(row)
+                rec(node["left_child"], tree_index, depth + 1,
+                    row["node_index"])
+                rec(node["right_child"], tree_index, depth + 1,
+                    row["node_index"])
+            else:
+                row["value"] = node["leaf_value"]
+                row["weight"] = node.get("leaf_weight")
+                row["count"] = node.get("leaf_count")
+                rows.append(row)
+
+        for ti in model["tree_info"]:
+            rec(ti["tree_structure"], ti["tree_index"], 1, None)
+        return pd.DataFrame(rows)
+
+    # ---- pickling / copying (reference: Booster.__getstate__, which
+    # serializes the handle to a model string; needed for sklearn
+    # ecosystem tools like joblib/GridSearchCV) ----
+    def __getstate__(self):
+        state = {
+            "params": self.params,
+            "best_iteration": self.best_iteration,
+            "best_score": self.best_score,
+            "attr": dict(self._attr),
+            "name_valid_sets": list(self.name_valid_sets),
+            "pandas_categorical": self.pandas_categorical,
+        }
+        state["model_str"] = (self.model_to_string()
+                              if self.num_trees() else None)
+        return state
+
+    def __setstate__(self, state):
+        self.__init__(params=state.get("params"),
+                      model_str=state.get("model_str"))
+        self.best_iteration = state.get("best_iteration", -1)
+        self.best_score = state.get("best_score", {})
+        self._attr = dict(state.get("attr", {}))
+        self.name_valid_sets = list(state.get("name_valid_sets", []))
+        pc = state.get("pandas_categorical")
+        if pc is not None:
+            self._loaded_meta["pandas_categorical"] = pc
+
+    def __copy__(self):
+        return self.__deepcopy__(None)
+
+    def __deepcopy__(self, _memodict):
+        model_str = self.model_to_string() if self.num_trees() else None
+        b = Booster(params=dict(self.params), model_str=model_str)
+        b.best_iteration = self.best_iteration
+        b.best_score = dict(self.best_score)
+        b._attr = dict(self._attr)
+        return b
 
     def shuffle_models(self, start_iteration: int = 0,
                        end_iteration: int = -1) -> "Booster":

@@ -123,6 +123,10 @@ _PARAMS: Dict[str, Tuple[Any, Tuple[str, ...]]] = {
     "scale_pos_weight": (1.0, ()),
     "sigmoid": (1.0, ()),
     "boost_from_average": (True, ()),
+    # extremely-randomized trees (reference config.h:319): each (leaf,
+    # feature) split search considers ONE uniformly-random threshold
+    "extra_trees": (False, ("extra_tree",)),
+    "extra_seed": (6, ()),
     "reg_sqrt": (False, ()),
     "alpha": (0.9, ()),
     "fair_c": (1.0, ()),

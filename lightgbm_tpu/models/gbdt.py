@@ -126,6 +126,8 @@ class GBDT:
                         incompat.append("forced splits")
                     if config.feature_fraction_bynode < 1.0:
                         incompat.append("feature_fraction_bynode")
+                    if config.extra_trees:
+                        incompat.append("extra_trees")
                     if incompat:
                         log.warning(
                             "histogram_pool_size is ignored for the "
@@ -157,6 +159,8 @@ class GBDT:
                 min_data_in_leaf=config.min_data_in_leaf,
                 min_sum_hessian_in_leaf=config.min_sum_hessian_in_leaf,
                 max_delta_step=config.max_delta_step,
+                extra_trees=bool(config.extra_trees),
+                extra_seed=int(config.extra_seed),
                 cat_features=cat_feats,
                 cat_l2=config.cat_l2, cat_smooth=config.cat_smooth,
                 max_cat_threshold=config.max_cat_threshold,
@@ -614,7 +618,8 @@ class GBDT:
                 def _grow_shard(b_, g_, h_, c_, nb_, na_, fm_, qs_, cegb_):
                     kw2 = ({"qseed": qs_}
                            if ((depthwise_fused and gp_grow.quant)
-                               or gp_grow.ff_bynode < 1.0)
+                               or gp_grow.ff_bynode < 1.0
+                               or gp_grow.split.extra_trees)
                            else {})
                     return grow_fn(b_, g_, h_, c_, nb_, na_, fm_, gp_grow,
                                    bundle=bundle, cegb=cegb_, **kw2)
@@ -641,7 +646,8 @@ class GBDT:
                 def _grow_shard(b_, g_, h_, c_, nb_, na_, fm_, qs_):
                     kw2 = ({"qseed": qs_}
                            if ((depthwise_fused and gp_grow.quant)
-                               or gp_grow.ff_bynode < 1.0)
+                               or gp_grow.ff_bynode < 1.0
+                               or gp_grow.split.extra_trees)
                            else {})
                     return grow_fn(b_, g_, h_, c_, nb_, na_, fm_, gp_grow,
                                    bundle=bundle, **kw2)
@@ -684,7 +690,8 @@ class GBDT:
             def do_grow(bins, gw, hw, cw, num_bins, na_bin, fmask, qs,
                         cegb_st):
                 kw = {"forced": forced} if forced is not None else {}
-                if (depthwise_fused and gp.quant) or gp.ff_bynode < 1.0:
+                if ((depthwise_fused and gp.quant) or gp.ff_bynode < 1.0
+                        or gp.split.extra_trees):
                     kw["qseed"] = qs
                 if use_cegb:
                     # CEGB bookkeeping threads across the k class trees of one
@@ -984,7 +991,8 @@ class GBDT:
             elif depthwise:
                 grow_tree_depthwise = self._grow_fn()  # honors lean_ft
                 qkw = ({"qseed": jnp.int32(self.iter_ * k + cls)}
-                       if (self.gp.quant or self.gp.ff_bynode < 1.0) else {})
+                       if (self.gp.quant or self.gp.ff_bynode < 1.0
+                           or self.gp.split.extra_trees) else {})
                 if self._cegb_dev is not None:
                     tree_dev, leaf_id, self._cegb_dev = grow_tree_depthwise(
                         ts.bins, gw, hw, cw, ts.num_bins_dev, ts.na_bin_dev,
@@ -997,7 +1005,8 @@ class GBDT:
                         forced=self._forced_dev, **qkw)
             else:
                 qkw2 = ({"qseed": jnp.int32(self.iter_ * k + cls)}
-                        if self.gp.ff_bynode < 1.0 else {})
+                        if (self.gp.ff_bynode < 1.0
+                            or self.gp.split.extra_trees) else {})
                 tree_dev, leaf_id = grow_tree(ts.bins, gw, hw, cw,
                                               ts.num_bins_dev, ts.na_bin_dev,
                                               fmask, self.gp,

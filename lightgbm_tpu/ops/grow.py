@@ -186,6 +186,15 @@ def grow_tree(bins: jnp.ndarray, g: jnp.ndarray, h: jnp.ndarray, c: jnp.ndarray,
         best_u = u_allowed >= u_allowed.max(axis=-1, keepdims=True)
         return base_mask & ((u < gp.ff_bynode) | best_u)
 
+    def _et_key(tag):
+        """extra_trees rand-threshold key per split search (reference:
+        per-search rand_threshold, feature_histogram.hpp:99-102)."""
+        if not sp.extra_trees:
+            return None
+        base = qseed if qseed is not None else jnp.int32(0)
+        return jax.random.fold_in(
+            jax.random.fold_in(jax.random.PRNGKey(sp.extra_seed), base), tag)
+
     leaf_id = jnp.zeros(n, dtype=jnp.int32)
     # pallas kernels read a transposed bin matrix; build it ONCE per tree (XLA
     # CSEs it across all histogram passes inside this jit)
@@ -198,7 +207,7 @@ def grow_tree(bins: jnp.ndarray, g: jnp.ndarray, h: jnp.ndarray, c: jnp.ndarray,
                        _node_mask(L, feature_mask), sp,   # tag L: root (child
                        # tags are the split steps 0..L-2; fold_in rejects -1)
                        allow_split=_allow_depth(jnp.int32(0), gp) if gp.max_depth > 0 else True,
-                       bundle=bundle)
+                       bundle=bundle, rand_key=_et_key(L))
 
     def tile(x, fill):
         return jnp.full((L,), fill, dtype=x.dtype).at[0].set(x)
@@ -437,7 +446,8 @@ def grow_tree(bins: jnp.ndarray, g: jnp.ndarray, h: jnp.ndarray, c: jnp.ndarray,
                 t, jnp.broadcast_to(feature_mask, (2, f)))
             bs = best_split(ch_hist, num_bins, na_bin, ch_g, ch_h, ch_c,
                             ch_mask, sp, allow,
-                            leaf_min=ch_min, leaf_max=ch_max, bundle=bundle)
+                            leaf_min=ch_min, leaf_max=ch_max, bundle=bundle,
+                            rand_key=_et_key(t))
 
             def upd(arr, vals):
                 return arr.at[l].set(vals[0]).at[new_leaf].set(vals[1])

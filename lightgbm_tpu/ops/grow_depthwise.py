@@ -282,10 +282,20 @@ def grow_tree_depthwise(bins: jnp.ndarray, g: jnp.ndarray, h: jnp.ndarray,
                 pen = pen + sp.cegb_tradeoff * lazy_cost
 
         # ---- best split for every frontier leaf (one batched kernel) ----
+        if sp.extra_trees:
+            # one random threshold per (leaf, feature) per level, keyed on
+            # (extra_seed, tree seed, level) like the reference's per-search
+            # rand_threshold (feature_histogram.hpp:99-102)
+            et_base = qseed if qseed is not None else jnp.int32(0)
+            et_key = jax.random.fold_in(
+                jax.random.fold_in(jax.random.PRNGKey(sp.extra_seed),
+                                   et_base), lvl)
+        else:
+            et_key = None
         res = best_split(st.hist, num_bins, na_bin, st.leaf_g, st.leaf_h,
                          st.leaf_c, search_mask, sp, st.active,
                          leaf_min=st.leaf_min, leaf_max=st.leaf_max,
-                         bundle=bundle, gain_penalty=pen)
+                         bundle=bundle, gain_penalty=pen, rand_key=et_key)
         if forced is not None:
             # ---- forced splits override the gain search (ForceSplits,
             # serial_tree_learner.cpp:456-618): leaves holding a forced-node

@@ -61,6 +61,13 @@ class SplitParams:
     # EFB: bundled columns present (static flag; the BundleArrays data rides
     # along as a traced argument)
     has_bundles: bool = False
+    # extremely-randomized trees (reference: extra_trees config.h:319 +
+    # feature_histogram.hpp:99-102,253): each (leaf, feature) search
+    # considers ONE random threshold — numerical unbundled candidates only,
+    # like the reference (categorical keeps its full subset search). Needs
+    # a ``rand_key`` operand at best_split call sites.
+    extra_trees: bool = False
+    extra_seed: int = 6
     # CEGB (reference: CostEfficientGradientBoosting,
     # cost_effective_gradient_boosting.hpp:26-45): per-candidate gain penalty
     # tradeoff*(penalty_split*n_leaf + coupled[f]*unused(f) + lazy on-demand
@@ -208,7 +215,7 @@ def best_split(hist: jnp.ndarray, num_bins: jnp.ndarray, na_bin: jnp.ndarray,
                parent_g, parent_h, parent_cnt,
                feature_mask: jnp.ndarray, p: SplitParams,
                allow_split=True, leaf_min=None, leaf_max=None,
-               bundle=None, gain_penalty=None) -> SplitResult:
+               bundle=None, gain_penalty=None, rand_key=None) -> SplitResult:
     """Find the best split for one leaf or a whole frontier of leaves.
 
     hist: [..., 3, F, B] channel-major (grad, hess, count); num_bins: [F] i32
@@ -295,6 +302,18 @@ def best_split(hist: jnp.ndarray, num_bins: jnp.ndarray, na_bin: jnp.ndarray,
         & fm3 & (~cat_mask_dev)[None, :, None]
     if p.has_bundles and bundle is not None:
         valid_t = valid_t & (~bundle.is_bundle)[None, :, None]
+    if p.extra_trees and rand_key is not None:
+        # extra_trees: only one random threshold per (leaf, feature)
+        # competes (reference draws rand_threshold per search and skips
+        # every other i, feature_histogram.hpp:253). A draw landing on the
+        # missing bin leaves that (leaf, feature) without a candidate this
+        # search — same effect as the reference's rand index falling on a
+        # skipped position.
+        u = jax.random.uniform(rand_key, (L, f))
+        rnd = jnp.floor(u * jnp.maximum(num_bins[None, :] - 1, 1)) \
+            .astype(jnp.int32)
+        rnd = jnp.minimum(rnd, num_bins[None, :] - 2)
+        valid_t = valid_t & (iota == rnd[:, :, None])
     has_na = na < b
     gain_r = jnp.where(valid_t, gain_r, NEG_INF)
     gain_l = jnp.where(valid_t & has_na, gain_l, NEG_INF)

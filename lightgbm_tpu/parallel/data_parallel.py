@@ -39,7 +39,7 @@ def grow_tree_dp(bins, g, h, c, num_bins, na_bin, feature_mask,
     gp_dp = gp if gp.axis_name == axis else \
         dataclasses.replace(gp, axis_name=axis)
 
-    if gp_dp.quant or gp_dp.ff_bynode < 1.0:
+    if gp_dp.quant or gp_dp.ff_bynode < 1.0 or gp_dp.split.extra_trees:
         # thread the stochastic-rounding / per-node-sampling seed as an
         # explicit replicated operand (a closed-over tracer is illegal under
         # shard_map) so the dither and feature subsets vary per tree on the

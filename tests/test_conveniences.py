@@ -228,3 +228,82 @@ def test_predict_from_file_path(tmp_path):
                header="label,a,b,c,d", comments="")
     np.testing.assert_allclose(b.predict(hdr, data_has_header=True),
                                expected, rtol=1e-5)
+
+
+# ---- round-5 batch 2: pickle/copy, trees_to_dataframe, per-feature params ----
+
+def test_booster_pickle_and_deepcopy():
+    # reference: test_save_load_copy_pickle — predictions survive the trip
+    import copy
+    import pickle
+    rng = np.random.RandomState(40)
+    X, y = rng.random_sample((200, 4)), rng.random_sample(200)
+    b = _train(X, y, n_iter=5)
+    b.set_attr(note="x")
+    b.best_iteration = 3
+    p = pickle.loads(pickle.dumps(b))
+    np.testing.assert_array_equal(p.predict(X, num_iteration=-1),
+                                  b.predict(X, num_iteration=-1))
+    assert p.attr("note") == "x" and p.best_iteration == 3
+    c = copy.deepcopy(b)
+    np.testing.assert_array_equal(c.predict(X, num_iteration=-1),
+                                  b.predict(X, num_iteration=-1))
+    c2 = copy.copy(b)
+    np.testing.assert_array_equal(c2.predict(X, num_iteration=-1),
+                                  b.predict(X, num_iteration=-1))
+
+
+def test_trees_to_dataframe():
+    # reference: test_engine.py test_trees_to_dataframe — node-per-row frame
+    rng = np.random.RandomState(41)
+    X, y = rng.random_sample((300, 4)), rng.random_sample(300)
+    b = _train(X, y, n_iter=3)
+    df = b.trees_to_dataframe()
+    trees = b._ensure_host_trees()
+    assert len(df) == sum(2 * t.num_leaves - 1 for t in trees)
+    assert set(df.columns) == {
+        "tree_index", "node_depth", "node_index", "left_child", "right_child",
+        "parent_index", "split_feature", "split_gain", "threshold",
+        "decision_type", "missing_direction", "missing_type", "value",
+        "weight", "count"}
+    # split rows reference children that exist as node rows
+    idx = set(df["node_index"])
+    splits = df[df["split_feature"].notna()]
+    assert set(splits["left_child"]).issubset(idx)
+    assert set(splits["right_child"]).issubset(idx)
+    # roots have no parent; every tree contributes exactly one root
+    assert (df["parent_index"].isna().sum() == len(trees))
+
+
+def test_per_feature_param_accessors_and_merge():
+    # reference: test_get_feature_penalty_and_monotone_constraints +
+    # test_add_features_feature_penalty / _monotone_types
+    rng = np.random.RandomState(42)
+    X = rng.random_sample((120, 4))
+    d = lgb.Dataset(X[:, :2], params={"feature_penalty": [0.5, 0.7],
+                                      "monotone_constraints": [1, 0]}).construct()
+    np.testing.assert_allclose(d.get_feature_penalty(), [0.5, 0.7])
+    np.testing.assert_array_equal(d.get_monotone_constraints(), [1, 0])
+    plain = lgb.Dataset(X[:, :2]).construct()
+    assert plain.get_feature_penalty() is None
+    assert plain.get_monotone_constraints() is None
+    # merge pads the missing side with neutral defaults (penalty 1, mono 0)
+    cases = [(None, [0.5, 0.5], [1.0, 1.0, 0.5, 0.5]),
+             ([0.5, 0.6], None, [0.5, 0.6, 1.0, 1.0]),
+             ([0.5, 0.6], [0.7, 0.8], [0.5, 0.6, 0.7, 0.8]),
+             (None, None, None)]
+    for pa, pb, want in cases:
+        d1 = lgb.Dataset(X[:, :2], params=(
+            {"feature_penalty": pa} if pa else {})).construct()
+        d2 = lgb.Dataset(X[:, 2:], params=(
+            {"feature_penalty": pb} if pb else {})).construct()
+        d1.add_features_from(d2)
+        got = d1.get_feature_penalty()
+        if want is None:
+            assert got is None
+        else:
+            np.testing.assert_allclose(got, want)
+    d3 = lgb.Dataset(X[:, :2], params={"monotone_constraints": [1, -1]}).construct()
+    d4 = lgb.Dataset(X[:, 2:]).construct()
+    d3.add_features_from(d4)
+    np.testing.assert_array_equal(d3.get_monotone_constraints(), [1, -1, 0, 0])

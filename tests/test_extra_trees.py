@@ -1,0 +1,51 @@
+"""extra_trees — extremely-randomized trees (reference: config.h:319 +
+feature_histogram.hpp:99-102,253: one random threshold per (leaf, feature)
+split search; categorical keeps its full subset search)."""
+import numpy as np
+
+import lightgbm_tpu as lgb
+
+
+def _train(X, y, extra, seed=6, grow="depthwise", n=8):
+    p = {"objective": "regression", "num_leaves": 15, "min_data_in_leaf": 5,
+         "verbosity": -1, "grow_policy": grow,
+         "extra_trees": extra, "extra_seed": seed}
+    return lgb.train(p, lgb.Dataset(X, label=y, params=p), n,
+                     verbose_eval=False)
+
+
+def test_extra_trees_changes_model_and_is_seeded():
+    rng = np.random.RandomState(17)
+    X = rng.random_sample((800, 6))
+    y = X[:, 0] * 2 + X[:, 1] + rng.random_sample(800) * 0.1
+    for grow in ("depthwise", "lossguide"):
+        b0 = _train(X, y, False, grow=grow)
+        b1 = _train(X, y, True, grow=grow)
+        b2 = _train(X, y, True, grow=grow)
+        b3 = _train(X, y, True, seed=99, grow=grow)
+        assert b1.model_to_string() == b2.model_to_string(), grow
+        assert b0.model_to_string() != b1.model_to_string(), grow
+        assert b1.model_to_string() != b3.model_to_string(), grow
+        # randomized thresholds still learn the signal
+        r = np.corrcoef(b1.predict(X), y)[0, 1]
+        assert r > 0.9, (grow, r)
+
+
+def test_extra_trees_with_categorical_keeps_full_cat_search():
+    rng = np.random.RandomState(19)
+    cat = rng.randint(0, 6, 600).astype(float)
+    X = np.column_stack([cat, rng.random_sample(600)])
+    y = (np.isin(cat, [1, 4])).astype(float) + rng.random_sample(600) * 0.05
+    p = {"objective": "regression", "num_leaves": 7, "min_data_in_leaf": 5,
+         "verbosity": -1, "extra_trees": True, "min_data_per_group": 1,
+         "cat_smooth": 1.0}
+    ds = lgb.Dataset(X, label=y, categorical_feature=[0], params=p)
+    b = lgb.Booster(params=p, train_set=ds)
+    for _ in range(5):
+        b.update()
+    # the categorical feature still splits with its exact subset search
+    used = {int(f) for t in b._ensure_host_trees()
+            for f in t.split_feature[: t.num_leaves - 1]}
+    assert 0 in used
+    r = np.corrcoef(b.predict(X), y)[0, 1]
+    assert r > 0.9, r
