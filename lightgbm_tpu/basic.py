@@ -253,7 +253,8 @@ class Dataset:
                 # allgather — identical mappers on every rank by construction
                 # (dataset_loader.cpp:957-1040)
                 from .parallel.dist_data import find_bin_mappers_distributed
-                mappers = find_bin_mappers_distributed(raw, **bin_kw)
+                mappers = find_bin_mappers_distributed(
+                    raw, retries=conf.network_retries, **bin_kw)
             else:
                 mappers = find_bin_mappers(raw, **bin_kw)
             _mark("find_bins_s")
@@ -749,7 +750,8 @@ class Booster:
         self._attr: Dict[str, str] = {}
 
         if model_file is not None:
-            with open(model_file) as f:
+            from .io import vfs
+            with vfs.open_text(model_file) as f:
                 self._load_model_string(f.read())
             return
         if model_str is not None:
@@ -806,8 +808,13 @@ class Booster:
         if fobj is not None:
             score = self.raw_train_score()
             grad, hess = fobj(score, self._gbdt.train_set)
-            grad = jnp.asarray(np.asarray(grad, dtype=np.float32))
-            hess = jnp.asarray(np.asarray(hess, dtype=np.float32))
+            grad = np.asarray(grad, dtype=np.float32)
+            hess = np.asarray(hess, dtype=np.float32)
+            grad, hess, skip = self._gbdt.guard_gradients(grad, hess)
+            if skip:
+                return self._gbdt.skip_one_iter()
+            grad = jnp.asarray(grad)
+            hess = jnp.asarray(hess)
             k = self._gbdt.num_tree_per_iteration
             if k > 1:
                 grad = grad.reshape(-1, k) if grad.ndim == 1 else grad
@@ -1056,8 +1063,12 @@ class Booster:
 
     def save_model(self, filename: str, num_iteration: Optional[int] = None,
                    start_iteration: int = 0) -> "Booster":
-        with open(filename, "w") as f:
-            f.write(self.model_to_string(num_iteration, start_iteration))
+        # write-to-temp + fsync + atomic rename: a crash mid-save never
+        # leaves a truncated model on disk (utils/atomic_io.py; the
+        # reference's plain fwrite can, gbdt_model_text.cpp)
+        from .utils import atomic_io
+        atomic_io.atomic_write_text(
+            filename, self.model_to_string(num_iteration, start_iteration))
         return self
 
     def model_to_string(self, num_iteration: Optional[int] = None,

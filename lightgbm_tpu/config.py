@@ -173,6 +173,19 @@ _PARAMS: Dict[str, Tuple[Any, Tuple[str, ...]]] = {
     "grow_policy": ("depthwise", ()),      # depthwise | lossguide (leaf-wise)
     "hist_dtype": ("float32", ()),         # histogram accumulator dtype
     "mesh_axis": ("data", ()),             # mesh axis name for data-parallel sharding
+    # ---- fault tolerance (new in this framework) ----
+    # where snapshot_freq dumps go; "" = the directory of output_model
+    # (the reference writes into CWD from every process, gbdt.cpp:291)
+    "snapshot_dir": ("", ()),
+    # snapshot retention: keep the newest N snapshots, prune older ones
+    "snapshot_keep": (3, ("snapshot_retention",)),
+    # what to do when gradients/scores/eval values go non-finite:
+    # fatal (reference CHECK semantics) | warn_skip_tree | clip
+    "nonfinite_policy": ("fatal", ("non_finite_policy", "nan_policy")),
+    # retry attempts for jax.distributed bootstrap / mapper allgather
+    "network_retries": (3, ()),
+    # fault-injection spec (utils/faults.py), e.g. "snapshot_write:2"
+    "faults": ("", ("fault_spec",)),
 }
 
 _LIST_FLOAT = {"feature_contri", "cegb_penalty_feature_lazy", "cegb_penalty_feature_coupled", "label_gain", "auc_mu_weights"}
@@ -284,6 +297,13 @@ class Config:
         if self.max_bin > 256:
             log.warning("max_bin > 256 not supported on TPU (uint8 bins); clamping to 256")
             self.max_bin = 256
+        if self.nonfinite_policy not in ("fatal", "warn_skip_tree", "clip"):
+            log.fatal("nonfinite_policy must be one of fatal|warn_skip_tree|"
+                      f"clip, got {self.nonfinite_policy!r}")
+        if self.snapshot_keep < 1:
+            log.fatal("snapshot_keep must be >= 1")
+        if self.network_retries < 1:
+            log.fatal("network_retries must be >= 1")
 
     def to_dict(self) -> Dict[str, Any]:
         out = {name: getattr(self, name) for name in _PARAMS}

@@ -7,15 +7,17 @@ alignment to the train set, early stopping, continued training from an init mode
 from __future__ import annotations
 
 import copy
+import math
 import time
 from typing import Any, Callable, Dict, List, Optional, Union
 
 import numpy as np
 
 from . import callback as cb
+from . import snapshot as snap
 from .basic import Booster, Dataset
 from .config import Config, params_to_config
-from .utils import log
+from .utils import faults, log
 from .utils.timer import TIMER
 
 
@@ -32,10 +34,22 @@ def train(params: Dict[str, Any], train_set: Dataset,
           evals_result: Optional[Dict] = None,
           verbose_eval: Union[bool, int] = True,
           keep_training_booster: bool = False,
-          callbacks: Optional[List[Callable]] = None) -> Booster:
-    """Train a booster (reference: engine.py:18)."""
+          callbacks: Optional[List[Callable]] = None,
+          resume_from_snapshot: Optional[str] = None) -> Booster:
+    """Train a booster (reference: engine.py:18).
+
+    ``resume_from_snapshot`` names a snapshot directory (or True for the
+    default one, see ``snapshot_dir``): the newest VALID snapshot there is
+    loaded — a truncated/corrupt one falls back to the previous — and
+    training continues losslessly from its iteration. When resumed,
+    ``num_boost_round`` is the TOTAL round count, so the resumed run stops
+    where the uninterrupted run would have (byte-identical final model
+    under the same params/seed).
+    """
     params = dict(params or {})
     conf = params_to_config(params)
+    if conf.faults:
+        faults.configure(conf.faults)
     if conf.num_iterations != 100 and num_boost_round == 100:
         num_boost_round = conf.num_iterations
     if conf.early_stopping_round and early_stopping_rounds is None:
@@ -51,6 +65,30 @@ def train(params: Dict[str, Any], train_set: Dataset,
     booster = Booster(params=params, train_set=train_set)
     if init_model is not None:
         _warm_start(booster, init_model)
+
+    # crash-safe resume: restore trainer state BEFORE valid sets attach, so
+    # their score replay (add_valid -> _predict_bins_dev) sees the loaded
+    # trees; fall back to training from scratch when nothing valid exists
+    resumed = False
+    es_resume_state = None
+    if resume_from_snapshot:
+        resume_dir = (snap.snapshot_dir_for(conf)
+                      if resume_from_snapshot is True
+                      else str(resume_from_snapshot))
+        payload = snap.load_latest_valid(resume_dir)
+        if payload is None:
+            log.warning(f"resume_from_snapshot: no valid snapshot under "
+                        f"{resume_dir!r}; training from scratch")
+        else:
+            try:
+                booster._gbdt.set_resume_state(payload.arrays, payload.meta)
+                es_resume_state = payload.es_state
+                resumed = True
+                log.info(f"resumed from {payload.model_path} "
+                         f"(iteration {payload.iteration})")
+            except ValueError as e:
+                log.warning(f"cannot resume from {payload.model_path}: {e}; "
+                            "training from scratch")
 
     valid_sets = valid_sets or []
     valid_names = valid_names or []
@@ -82,12 +120,32 @@ def train(params: Dict[str, Any], train_set: Dataset,
     callbacks_before.sort(key=lambda c: getattr(c, "order", 0))
     callbacks_after.sort(key=lambda c: getattr(c, "order", 0))
 
+    if es_resume_state is not None:
+        for c in callbacks:
+            imp = getattr(c, "_es_import", None)
+            if imp is not None:
+                imp(es_resume_state)
+
     begin_iteration = booster.current_iteration
-    end_iteration = begin_iteration + num_boost_round
+    if resumed:
+        # num_boost_round is the TOTAL when resuming: the resumed run must
+        # end where the uninterrupted one would have
+        end_iteration = max(begin_iteration, num_boost_round)
+        if begin_iteration >= num_boost_round:
+            log.warning(f"snapshot already at iteration {begin_iteration} >= "
+                        f"num_boost_round={num_boost_round}; no further "
+                        "boosting")
+    else:
+        end_iteration = begin_iteration + num_boost_round
+    snapshot_dir = snap.snapshot_dir_for(conf)
+    nf_eval_warned: set = set()
     finished = False
     t_start = time.perf_counter()
     try:
         for i in range(begin_iteration, end_iteration):
+            # fault point for kill-and-resume tests: an armed 'tree_update'
+            # fault propagates out of train() like a crash at iteration i
+            faults.fault_point("tree_update")
             for c in callbacks_before:
                 c(cb.CallbackEnv(model=booster, params=params, iteration=i,
                                  begin_iteration=begin_iteration,
@@ -104,6 +162,8 @@ def train(params: Dict[str, Any], train_set: Dataset,
                     if feval is not None:
                         evaluation_result_list.extend(
                             _run_feval(feval, booster, train_set, eval_training))
+                _check_eval_finite(evaluation_result_list,
+                                   conf.nonfinite_policy, nf_eval_warned, i)
             for c in callbacks_after:
                 c(cb.CallbackEnv(model=booster, params=params, iteration=i,
                                  begin_iteration=begin_iteration,
@@ -115,11 +175,27 @@ def train(params: Dict[str, Any], train_set: Dataset,
                     and (i + 1) % conf.metric_freq == 0:
                 log.debug("%.6f seconds elapsed, finished iteration %d",
                           time.perf_counter() - t_start, i + 1)
-            # periodic snapshots (reference: gbdt.cpp:291-295 snapshot_freq)
-            if conf.snapshot_freq > 0 and (i + 1) % conf.snapshot_freq == 0:
-                snap = f"snapshot_iter_{i + 1}.txt"
-                booster.save_model(snap)
-                log.info("Saved snapshot to %s", snap)
+            # periodic snapshots (reference: gbdt.cpp:291-295 snapshot_freq),
+            # crash-safe and rank-0-only (the reference wrote into CWD from
+            # every process): atomic model text + state sidecar + manifest
+            # with keep-last-N retention, written with backoff retries; a
+            # snapshot that still fails is WARNED, training continues
+            if conf.snapshot_freq > 0 and (i + 1) % conf.snapshot_freq == 0 \
+                    and snap.is_writer_rank():
+                es_state = None
+                for c in callbacks:
+                    exp = getattr(c, "_es_export", None)
+                    if exp is not None:
+                        es_state = exp()
+                try:
+                    path = snap.write_snapshot(
+                        booster, snapshot_dir, i + 1,
+                        keep=conf.snapshot_keep, es_state=es_state)
+                    log.info("Saved snapshot to %s", path)
+                except Exception as e:
+                    log.warning(f"snapshot at iteration {i + 1} failed after "
+                                f"retries ({type(e).__name__}: {e}); "
+                                "training continues")
             if finished:
                 log.warning("Stopped training because there are no more leaves "
                             "that meet the split requirements")
@@ -136,6 +212,30 @@ def train(params: Dict[str, Any], train_set: Dataset,
     if conf.verbosity >= 2:
         log.debug(TIMER.summary_string())
     return booster
+
+
+def _check_eval_finite(results, policy: str, warned: set,
+                       iteration: int) -> None:
+    """Non-finite guard on eval values: a NaN metric means the scores (or a
+    custom feval) blew up — fatal policy aborts naming the metric, the
+    lenient policies warn once per (dataset, metric)."""
+    for r in results:
+        name, metric, val = r[0], r[1], r[2]
+        try:
+            finite = math.isfinite(float(val))
+        except (TypeError, ValueError):
+            continue
+        if finite:
+            continue
+        if policy == "fatal":
+            log.fatal(f"non-finite eval value {val!r} for {name}'s {metric} "
+                      f"at iteration {iteration + 1} "
+                      "(nonfinite_policy=fatal)")
+        if (name, metric) not in warned:
+            warned.add((name, metric))
+            log.warning(f"non-finite eval value {val!r} for {name}'s "
+                        f"{metric} at iteration {iteration + 1} "
+                        f"(nonfinite_policy={policy})")
 
 
 def _run_feval(feval, booster, train_set, eval_training):

@@ -52,11 +52,19 @@ def open_text(path: str, encoding: str = "utf-8"):
 
 
 def exists(path: str) -> bool:
-    if _scheme_of(path):
-        try:
-            with open_file(path, "rb"):
-                return True
-        except Exception:
-            return False
-    import os
-    return os.path.exists(path)
+    """Whether ``path`` is readable. A transport error on a scheme path is
+    NOT silently "missing": it logs a warning with the exception class so a
+    flaky remote store doesn't masquerade as an absent file (only a clean
+    FileNotFoundError/not-found answer returns False quietly)."""
+    if not _scheme_of(path):
+        import os
+        return os.path.exists(path)
+    try:
+        with open_file(path, "rb"):
+            return True
+    except FileNotFoundError:
+        return False
+    except Exception as e:
+        log.warning(f"vfs.exists({path!r}): transport error "
+                    f"({type(e).__name__}: {e}); treating as missing")
+        return False

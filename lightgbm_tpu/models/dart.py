@@ -114,6 +114,16 @@ class DART(GBDT):
                 self.tree_weights[ti] *= factor
                 self._add_tree_score(ti, cls, +1.0)
 
+    # ---- crash-safe resume (snapshot sidecar) ----
+    def _extra_resume_state(self, arrays, meta) -> None:
+        arrays["dart_tree_weights"] = np.asarray(self.tree_weights,
+                                                 dtype=np.float64)
+
+    def _apply_extra_resume_state(self, arrays, meta) -> None:
+        self.tree_weights = [float(w) for w in
+                             arrays.get("dart_tree_weights", [])]
+        self._drop_idx = []
+
     def _scale_tree(self, tree_idx: int, scale: float, in_score: bool) -> None:
         """Multiply a stored tree's leaf values by ``scale``; if its contribution
         is currently in the scores, keep them consistent."""

@@ -27,6 +27,9 @@ from ..utils import log
 from .tree import Tree, stack_trees
 
 K_EPSILON = 1e-15
+# score magnitude cap for nonfinite_policy=clip (far beyond any sane boosted
+# score, small enough that f32 sums of clipped values stay finite)
+_NF_CLIP = 1e30
 
 
 class GBDT:
@@ -48,6 +51,12 @@ class GBDT:
         self.num_tree_per_iteration = (
             objective.num_model_per_iteration if objective is not None else config.num_class)
         self.learning_rate = config.learning_rate
+        # non-finite guard policy (fatal | warn_skip_tree | clip); fatal and
+        # clip piggyback detection on the lagged async queue so the fused
+        # pipeline never blocks, warn_skip_tree checks synchronously so the
+        # offending tree can be discarded before any state mutates
+        self._nf_policy = config.nonfinite_policy
+        self._nf_warned = False
         self.models_dev: List[TreeArrays] = []   # per-tree device arrays (leaf values final)
         self.models_host: List[Tree] = []        # lazily converted
         self.valid_sets: List = []
@@ -243,6 +252,16 @@ class GBDT:
         if config.num_machines > 1:
             from ..parallel.mesh import init_distributed
             init_distributed(config)
+        # pre-training consistency fence: verify every rank holds identical
+        # training-relevant config + bin mappers + feature map BEFORE the
+        # first collective (parallel/fence.py; dist_data.py invariant)
+        try:
+            _nproc = jax.process_count()
+        except Exception:
+            _nproc = 1
+        if _nproc > 1:
+            from ..parallel.fence import consistency_fence
+            consistency_fence(config, train_set)
         self._dp = (config.tree_learner in ("data", "data_parallel", "voting")
                     and len(jax.devices()) > 1)
         # feature-parallel (#25): full data replicated, features sharded,
@@ -624,7 +643,8 @@ class GBDT:
                     return grow_fn(b_, g_, h_, c_, nb_, na_, fm_, gp_grow,
                                    bundle=bundle, cegb=cegb_, **kw2)
 
-                grow_sm = jax.shard_map(
+                from ..parallel.mesh import shard_map_compat
+                grow_sm = shard_map_compat(
                     _grow_shard, mesh=mesh,
                     in_specs=(PS(axis, None), PS(axis), PS(axis), PS(axis),
                               PS(), PS(), PS(), PS(), cegb_spec),
@@ -652,7 +672,8 @@ class GBDT:
                     return grow_fn(b_, g_, h_, c_, nb_, na_, fm_, gp_grow,
                                    bundle=bundle, **kw2)
 
-                grow_sm = jax.shard_map(
+                from ..parallel.mesh import shard_map_compat
+                grow_sm = shard_map_compat(
                     _grow_shard, mesh=mesh,
                     in_specs=(PS(axis, None), PS(axis), PS(axis), PS(axis),
                               PS(), PS(), PS(), PS()),
@@ -749,6 +770,7 @@ class GBDT:
         k = self.num_tree_per_iteration
         obj = self.objective
         one_class = self._make_one_class(custom)
+        nf = self._nf_policy
 
         def step(bins, num_bins, na_bin, score, fmask, bag_mask, grad, hess,
                  shrink, qseed, titer, cegb_st):
@@ -763,20 +785,40 @@ class GBDT:
                         new_score, cegb_st, grad, hess, cls, bins, num_bins,
                         na_bin, fmask, bag_mask, shrink, qseed, titer)
                     trees.append((tree, leaf_id))
-                return trees, new_score, cegb_st
-            # large k (VERDICT r4 weak #4): ONE grower compilation scanned
-            # over the class axis — the reference's per-class loop inside a
-            # single TrainOneIter (gbdt.cpp:401) without per-class dispatch
-            # or k unrolled copies of the grower program
-            def body(carry, cls):
-                new_score, cegb_c = carry
-                tree, leaf_id, new_score, cegb_c = one_class(
-                    new_score, cegb_c, grad, hess, cls, bins, num_bins,
-                    na_bin, fmask, bag_mask, shrink, qseed, titer)
-                return (new_score, cegb_c), (tree, leaf_id)
-            (new_score, cegb_st), stacked = jax.lax.scan(
-                body, (score, cegb_st), jnp.arange(k, dtype=jnp.int32))
-            return stacked, new_score, cegb_st
+            else:
+                # large k (VERDICT r4 weak #4): ONE grower compilation scanned
+                # over the class axis — the reference's per-class loop inside a
+                # single TrainOneIter (gbdt.cpp:401) without per-class dispatch
+                # or k unrolled copies of the grower program
+                def body(carry, cls):
+                    new_score, cegb_c = carry
+                    tree, leaf_id, new_score, cegb_c = one_class(
+                        new_score, cegb_c, grad, hess, cls, bins, num_bins,
+                        na_bin, fmask, bag_mask, shrink, qseed, titer)
+                    return (new_score, cegb_c), (tree, leaf_id)
+                (new_score, cegb_st), trees = jax.lax.scan(
+                    body, (score, cegb_st), jnp.arange(k, dtype=jnp.int32))
+            # non-finite guard: one fused reduce — the flag rides the same
+            # async queue as the leaf counts, so fatal/clip detection costs
+            # zero extra host syncs (reference analog: the CHECK macros on
+            # leaf outputs, gbdt.cpp)
+            ok = jnp.isfinite(new_score).all()
+            if nf == "clip":
+                def _san(a):
+                    return jnp.clip(jnp.nan_to_num(
+                        a, nan=0.0, posinf=_NF_CLIP, neginf=-_NF_CLIP),
+                        -_NF_CLIP, _NF_CLIP)
+                new_score = _san(new_score)
+                if k <= 8:
+                    trees = [(t._replace(leaf_value=_san(t.leaf_value),
+                                         internal_value=_san(t.internal_value)),
+                              lid) for t, lid in trees]
+                else:
+                    st, lids = trees
+                    trees = (st._replace(leaf_value=_san(st.leaf_value),
+                                         internal_value=_san(st.internal_value)),
+                             lids)
+            return trees, new_score, cegb_st, ok
 
         return jax.jit(step)
 
@@ -817,15 +859,13 @@ class GBDT:
                                         self._fp_na_bin)
         else:
             bins_arg, nb_arg, na_arg = ts.bins, ts.num_bins_dev, ts.na_bin_dev
-        trees, new_score, cegb_out = fn(
+        trees, new_score, cegb_out, ok = fn(
             bins_arg, nb_arg, na_arg,
             self.train_score, self._feature_mask(), bag,
             grad if custom else dummy,
             hess if custom else dummy,
             jnp.float32(shrink), jnp.int32(self.iter_),
             jnp.float32(self.iter_ + 1), cegb_in)
-        if self._cegb_dev is not None:
-            self._cegb_dev = cegb_out
         k = self.num_tree_per_iteration
         if k > 8:
             # scan path returns class-stacked TreeArrays; unstack in ONE
@@ -840,7 +880,7 @@ class GBDT:
                         for i in range(k))
                 unst = self._unstack_fn = jax.jit(_unstack)
             trees = list(unst(stacked, lids))
-        return trees, new_score
+        return trees, new_score, cegb_out, ok
 
     def _grow_fn(self):
         if self.config.grow_policy == "depthwise":
@@ -854,7 +894,17 @@ class GBDT:
     def _grow_and_update(self, grad, hess) -> bool:
         k = self.num_tree_per_iteration
         if self._supports_fused:
-            trees, new_score = self._fused_step(grad, hess)
+            trees, new_score, cegb_out, ok = self._fused_step(grad, hess)
+            if self._nf_policy == "warn_skip_tree" and not bool(ok):
+                # synchronous by design: the tree must be discarded BEFORE
+                # any booster state mutates, so this policy pays one host
+                # sync per iteration (fatal/clip stay lag-checked)
+                log.warning(f"non-finite scores at iteration {self.iter_}; "
+                            "discarding this iteration's tree(s) "
+                            "(nonfinite_policy=warn_skip_tree)")
+                return False
+            if self._cegb_dev is not None:
+                self._cegb_dev = cegb_out
             # average-output mode (RF) bakes init into its constant gradient
             # score, never into the stored trees
             bias_active = (self.iter_ == 0 and not self.average_output
@@ -889,9 +939,15 @@ class GBDT:
                     x.copy_to_host_async()
                 except Exception:
                     pass
-            q.append(cnts)
+            # the finite flag rides the same lagged queue: zero added syncs
+            try:
+                ok.copy_to_host_async()
+            except Exception:
+                pass
+            q.append((self.iter_, cnts, ok))
             if len(q) > 8:
-                old = q.pop(0)
+                it_old, old, okf = q.pop(0)
+                self._check_nf_flag(it_old, okf)
                 if all(int(x) <= 1 for x in old):
                     self._pop_trailing_stumps()
                     return True
@@ -930,10 +986,30 @@ class GBDT:
         shrinkage*leaf_value — the reference stops without adding them
         (gbdt.cpp:430)."""
         q = getattr(self, "_pending_leafcounts_q", None)
-        if q and any(all(int(x) <= 1 for x in cnts) for cnts in q):
-            self._pop_trailing_stumps()
+        if q:
+            for it_no, _cnts, okf in q:
+                self._check_nf_flag(it_no, okf)
+            if any(all(int(x) <= 1 for x in cnts) for _i, cnts, _f in q):
+                self._pop_trailing_stumps()
         if q is not None:
             q.clear()
+
+    def _check_nf_flag(self, it_no: int, okf) -> None:
+        """Consume one lag-queued finite flag (fatal raises, clip warns once;
+        detection lags <= 8 iterations behind the offending step by design —
+        the flag is only forced once its device copy is long finished)."""
+        if okf is None or bool(okf):
+            return
+        if self._nf_policy != "fatal":
+            if not self._nf_warned:
+                self._nf_warned = True
+                log.warning(f"non-finite scores around iteration {it_no} "
+                            f"(nonfinite_policy={self._nf_policy})")
+            return
+        log.fatal(f"non-finite scores detected at iteration {it_no} "
+                  "(nonfinite_policy=fatal): gradients, hessians or leaf "
+                  "values overflowed — lower learning_rate / check the "
+                  "objective, or set nonfinite_policy=warn_skip_tree|clip")
 
     def _update_valid_scores(self, tree_dev, cls: int, bias: float = 0.0) -> None:
         """Route each valid set through the finished tree and fold the
@@ -1018,6 +1094,14 @@ class GBDT:
             self._update_scores(tree_dev, leaf_id, cls)
             if int(tree_dev.num_leaves) > 1:
                 any_split = True
+        if self._nf_policy == "clip":
+            self.train_score = jnp.clip(
+                jnp.nan_to_num(self.train_score, nan=0.0, posinf=_NF_CLIP,
+                               neginf=-_NF_CLIP), -_NF_CLIP, _NF_CLIP)
+        else:
+            # the slow path already syncs per tree; a synchronous check is free
+            self._check_nf_flag(self.iter_,
+                                jnp.isfinite(self.train_score).all())
         return not any_split
 
     def _make_ghc(self, g, h) -> Tuple[jnp.ndarray, jnp.ndarray, jnp.ndarray]:
@@ -1169,3 +1253,172 @@ class GBDT:
         if self.average_output and self.models_dev:
             out = out / (len(self.models_dev) // k)
         return out
+
+    # ---- custom-gradient guard (Booster.update fobj path) ----
+    def guard_gradients(self, grad: np.ndarray, hess: np.ndarray):
+        """Non-finite guard on externally-supplied (custom fobj) gradients;
+        returns (grad, hess, skip). Host-side and free: the fobj path already
+        materialized numpy arrays."""
+        finite = bool(np.isfinite(grad).all() and np.isfinite(hess).all())
+        if finite:
+            return grad, hess, False
+        if self._nf_policy == "clip":
+            if not self._nf_warned:
+                self._nf_warned = True
+                log.warning(f"custom objective produced non-finite gradients "
+                            f"at iteration {self.iter_}; clipping "
+                            "(nonfinite_policy=clip)")
+            grad = np.clip(np.nan_to_num(grad, nan=0.0, posinf=_NF_CLIP,
+                                         neginf=-_NF_CLIP), -_NF_CLIP, _NF_CLIP)
+            hess = np.clip(np.nan_to_num(hess, nan=0.0, posinf=_NF_CLIP,
+                                         neginf=-_NF_CLIP), -_NF_CLIP, _NF_CLIP)
+            return grad, hess, False
+        if self._nf_policy == "fatal":
+            log.fatal(f"custom objective produced non-finite gradients at "
+                      f"iteration {self.iter_} (nonfinite_policy=fatal)")
+        log.warning(f"custom objective produced non-finite gradients at "
+                    f"iteration {self.iter_}; skipping this iteration "
+                    "(nonfinite_policy=warn_skip_tree)")
+        return grad, hess, True
+
+    def skip_one_iter(self) -> bool:
+        """Advance the iteration counter without growing trees (the
+        warn_skip_tree policy discarded this iteration's gradients)."""
+        self.iter_ += 1
+        return False
+
+    # ---- crash-safe resume (snapshot sidecar; snapshot.py) ----
+    # config fields that determine the training trajectory: a snapshot only
+    # resumes under a config that agrees on ALL of these (byte-identical
+    # resume is meaningless otherwise)
+    _RESUME_FP_KEYS = (
+        "objective", "boosting", "num_class", "num_leaves", "max_depth",
+        "learning_rate", "max_bin", "min_data_in_leaf",
+        "min_sum_hessian_in_leaf", "lambda_l1", "lambda_l2",
+        "min_gain_to_split", "max_delta_step", "bagging_fraction",
+        "pos_bagging_fraction", "neg_bagging_fraction", "bagging_freq",
+        "bagging_seed", "feature_fraction", "feature_fraction_bynode",
+        "feature_fraction_seed", "extra_trees", "extra_seed", "grow_policy",
+        "tree_learner", "use_quantized_grad", "seed", "data_random_seed",
+        "boost_from_average", "drop_rate", "skip_drop", "max_drop",
+        "uniform_drop", "xgboost_dart_mode", "drop_seed", "top_rate",
+        "other_rate")
+
+    def _resume_fingerprint(self) -> Dict:
+        c = self.config
+        out = {}
+        for key in self._RESUME_FP_KEYS:
+            v = getattr(c, key, None)
+            out[key] = list(v) if isinstance(v, (list, tuple)) else v
+        out["boosting_class"] = type(self).__name__
+        out["num_data"] = int(self.train_set.num_data)
+        out["num_features"] = int(self.train_set.num_features)
+        return out
+
+    def get_resume_state(self) -> Tuple[Dict[str, np.ndarray], Dict]:
+        """Exact trainer state for the snapshot sidecar: device tree arrays,
+        the f32 score vector, and every RNG stream. The model TEXT cannot
+        serve this purpose — bias folding rounds in f32 and from_string
+        cannot recover threshold_bin — so resuming from text would diverge
+        from the uninterrupted run; resuming from this state is bytewise
+        lossless (proven by tests/test_zz_fault_tolerance.py)."""
+        arrays: Dict[str, np.ndarray] = {}
+        meta: Dict = {
+            "format_version": 1,
+            "iter": int(self.iter_),
+            "num_trees": len(self.models_dev),
+            "learning_rate": float(self.learning_rate),
+            "has_init_score": bool(self._has_init_score),
+            "has_bag_mask": self._bag_mask is not None,
+            "fingerprint": self._resume_fingerprint(),
+        }
+        arrays["train_score"] = np.asarray(self.train_score)
+        arrays["init_scores"] = np.asarray(self.init_scores, dtype=np.float64)
+        arrays["bag_key"] = np.asarray(self._bag_key)
+        if self._bag_mask is not None:
+            arrays["bag_mask"] = np.asarray(self._bag_mask)
+        for nm in ("_feat_rng", "_bag_rng", "_drop_rng"):
+            r = getattr(self, nm, None)
+            if isinstance(r, np.random.RandomState):
+                st = r.get_state()
+                arrays[f"rng{nm}_keys"] = np.asarray(st[1], dtype=np.uint32)
+                arrays[f"rng{nm}_pos"] = np.asarray([st[2], st[3]],
+                                                    dtype=np.int64)
+                arrays[f"rng{nm}_gauss"] = np.asarray([st[4]],
+                                                      dtype=np.float64)
+        if self.models_dev:
+            # ONE batched device_get, then per-field stacking (same rationale
+            # as finalize: per-field readbacks cost a tunnel round-trip each)
+            host = jax.device_get(self.models_dev)
+            for f in TreeArrays._fields:
+                arrays[f"trees_{f}"] = np.stack(
+                    [np.asarray(getattr(t, f)) for t in host])
+        if self._cegb_dev is not None:
+            for f in self._cegb_dev._fields:
+                arrays[f"cegb_{f}"] = np.asarray(getattr(self._cegb_dev, f))
+        self._extra_resume_state(arrays, meta)
+        return arrays, meta
+
+    def set_resume_state(self, arrays: Dict[str, np.ndarray],
+                         meta: Dict) -> None:
+        """Restore trainer state saved by :meth:`get_resume_state`. Raises
+        ValueError when the snapshot was taken under a different config/
+        dataset (named field diff), BEFORE mutating any state."""
+        fp = self._resume_fingerprint()
+        got = dict(meta.get("fingerprint") or {})
+        diff = sorted(k for k in set(fp) | set(got)
+                      if fp.get(k) != got.get(k))
+        if diff:
+            raise ValueError(
+                "snapshot was taken under a different configuration; "
+                "mismatched field(s): " + ", ".join(diff))
+        if tuple(arrays["train_score"].shape) != tuple(self.train_score.shape):
+            raise ValueError(
+                f"snapshot score shape {arrays['train_score'].shape} != "
+                f"trainer score shape {tuple(self.train_score.shape)}")
+        self.iter_ = int(meta["iter"])
+        self.learning_rate = float(meta["learning_rate"])
+        self._has_init_score = bool(meta["has_init_score"])
+        self.init_scores = np.asarray(arrays["init_scores"],
+                                      dtype=np.float64)
+        self.train_score = jnp.asarray(arrays["train_score"])
+        self._bag_key = jnp.asarray(arrays["bag_key"])
+        self._bag_mask = (jnp.asarray(arrays["bag_mask"])
+                          if "bag_mask" in arrays else None)
+        for nm in ("_feat_rng", "_bag_rng", "_drop_rng"):
+            r = getattr(self, nm, None)
+            key = f"rng{nm}_keys"
+            if isinstance(r, np.random.RandomState) and key in arrays:
+                pos = arrays[f"rng{nm}_pos"]
+                r.set_state(("MT19937", arrays[key], int(pos[0]),
+                             int(pos[1]),
+                             float(arrays[f"rng{nm}_gauss"][0])))
+        n_trees = int(meta["num_trees"])
+        self.models_dev = []
+        self.models_host = []
+        if n_trees:
+            dev = {f: jnp.asarray(arrays[f"trees_{f}"])
+                   for f in TreeArrays._fields}
+            for t in range(n_trees):
+                self.models_dev.append(TreeArrays(
+                    **{f: dev[f][t] for f in TreeArrays._fields}))
+        if self._cegb_dev is not None and "cegb_feature_used" in arrays:
+            fields = {f: jnp.asarray(arrays[f"cegb_{f}"])
+                      for f in self._cegb_dev._fields}
+            if self._dp and fields["data_used"].shape[0] > 1:
+                from ..parallel.mesh import shard_rows
+                fields["data_used"] = shard_rows(fields["data_used"],
+                                                 self._mesh)
+            self._cegb_dev = type(self._cegb_dev)(**fields)
+        q = getattr(self, "_pending_leafcounts_q", None)
+        if q is not None:
+            q.clear()
+        self._apply_extra_resume_state(arrays, meta)
+
+    def _extra_resume_state(self, arrays: Dict[str, np.ndarray],
+                            meta: Dict) -> None:
+        """Subclass hook: stash variant-specific state (DART tree weights)."""
+
+    def _apply_extra_resume_state(self, arrays: Dict[str, np.ndarray],
+                                  meta: Dict) -> None:
+        """Subclass hook: restore what _extra_resume_state stashed."""

@@ -117,6 +117,7 @@ def find_bin_mappers_distributed(
     seed: int = 1,
     forced_bins=None,
     max_bin_by_feature=None,
+    retries: int = 3,
 ) -> List[BinMapper]:
     """Identical-by-construction mappers across jax.distributed processes.
 
@@ -149,7 +150,18 @@ def find_bin_mappers_distributed(
         enc[lo + j] = _encode_mapper(m, width)
     # one collective replaces the reference's serialized-BinMapper Allgather
     # (dataset_loader.cpp:1028); summing is exact because every rank
-    # contributes zeros outside its own slice
-    gathered = np.asarray(multihost_utils.process_allgather(enc))  # [nm, F, W]
+    # contributes zeros outside its own slice. Transient collective failures
+    # retry with backoff (every rank re-enters the SAME allgather, so a
+    # retried round stays collective-consistent)
+    from ..utils import faults
+    from ..utils.retry import call_with_backoff
+
+    def _gather():
+        faults.fault_point("mapper_allgather")
+        return np.asarray(multihost_utils.process_allgather(enc))
+
+    gathered = call_with_backoff(_gather, attempts=max(1, retries),
+                                 base_delay=0.2,
+                                 name="bin-mapper allgather")  # [nm, F, W]
     full = gathered.sum(axis=0)
     return [_decode_mapper(full[j]) for j in range(f)]

@@ -99,6 +99,7 @@ def grow_tree_fp(bins, g, h, c, num_bins, na_bin, feature_mask,
     c = jax.device_put(c, rep)
     feature_mask = jax.device_put(feature_mask, vec)
 
-    with jax.set_mesh(mesh):
+    from .mesh import mesh_context
+    with mesh_context(mesh):
         return grow_tree_depthwise(bins, g, h, c, num_bins, na_bin,
                                    feature_mask, gp, bundle=bundle)

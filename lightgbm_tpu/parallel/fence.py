@@ -1,0 +1,120 @@
+"""Pre-training consistency fence for multi-host runs.
+
+``parallel/dist_data.py`` documents the invariant this package lives or dies
+by: every rank must hold identical bin mappers, feature map, and
+training-relevant config before the first psum, or the collectives silently
+average apples with oranges and the model is garbage with no diagnostic. The
+reference trusts its Network::Init handshake plus "everyone read the same
+config file"; here we VERIFY: each rank hashes its training-relevant state,
+the digests are allgathered (the one collective guaranteed to work even when
+the state disagrees — fixed shape, fixed dtype), and any mismatch aborts
+before the first boosting iteration with a per-rank diff naming the field.
+
+Digests are sha256 truncated to 64 bits, shipped as ``[n_items, 2]`` uint32
+(jax disables x64 by default — a uint64 array would silently truncate).
+"""
+from __future__ import annotations
+
+import hashlib
+from typing import List, Tuple
+
+import numpy as np
+
+from ..utils import log
+
+# config fields that alter the training trajectory; divergence in any of
+# these yields per-rank split decisions that the psum then blends silently
+FENCE_CONFIG_FIELDS = (
+    "objective", "boosting", "num_class", "num_iterations", "learning_rate",
+    "num_leaves", "max_depth", "max_bin", "min_data_in_leaf",
+    "min_sum_hessian_in_leaf", "lambda_l1", "lambda_l2", "min_gain_to_split",
+    "max_delta_step", "bagging_fraction", "pos_bagging_fraction",
+    "neg_bagging_fraction", "bagging_freq", "bagging_seed",
+    "feature_fraction", "feature_fraction_bynode", "feature_fraction_seed",
+    "extra_trees", "extra_seed", "grow_policy", "tree_learner",
+    "use_quantized_grad", "seed", "data_random_seed", "boost_from_average",
+    "monotone_constraints", "feature_contri", "cegb_penalty_split",
+    "cegb_penalty_feature_coupled", "cegb_penalty_feature_lazy",
+    "drop_rate", "skip_drop", "max_drop", "uniform_drop",
+    "xgboost_dart_mode", "drop_seed", "top_rate", "other_rate",
+)
+
+
+def _digest(data: bytes) -> np.ndarray:
+    """64-bit sha256 prefix as uint32[2] (x64-safe on the wire)."""
+    return np.frombuffer(hashlib.sha256(data).digest()[:8],
+                         dtype=np.uint32).copy()
+
+
+def _mapper_bytes(m) -> bytes:
+    head = repr((int(m.bin_type), int(m.missing_type), int(m.num_bins),
+                 int(m.default_bin), int(m.most_freq_bin),
+                 bool(m.is_trivial))).encode()
+    ub = np.asarray(m.upper_bounds, dtype=np.float64).tobytes()
+    cv = np.asarray(m.cat_values, dtype=np.int64).tobytes()
+    return head + ub + cv
+
+
+def fence_items(config, train_set=None) -> List[Tuple[str, bytes]]:
+    """Named byte-strings each rank hashes. Item COUNT and ORDER must be
+    rank-invariant (allgather needs equal shapes), so all mappers fold into
+    one combined item regardless of how many a divergent rank decoded."""
+    items: List[Tuple[str, bytes]] = [
+        (f"config.{f}", repr(getattr(config, f, None)).encode())
+        for f in FENCE_CONFIG_FIELDS]
+    mappers = getattr(train_set, "mappers", None) if train_set is not None \
+        else None
+    h = hashlib.sha256()
+    for m in (mappers or []):
+        h.update(_mapper_bytes(m))
+    items.append(("data.bin_mappers", h.digest()))
+    fm = getattr(train_set, "feature_map", None) if train_set is not None \
+        else None
+    items.append(("data.feature_map",
+                  b"none" if fm is None
+                  else np.asarray(fm, dtype=np.int64).tobytes()))
+    items.append(("data.num_features",
+                  repr(getattr(train_set, "num_features", None)
+                       if train_set is not None else None).encode()))
+    return items
+
+
+def consistency_fence(config, train_set=None, raise_on_mismatch: bool = True
+                      ) -> bool:
+    """Allgather per-rank digests and fail fast on divergence.
+
+    Returns True when all ranks agree (trivially true single-process). On
+    mismatch raises LightGBMError (via log.fatal) with a per-rank digest
+    diff naming each mismatched field, unless ``raise_on_mismatch=False``
+    (then warns and returns False — used by tests to inspect the verdict).
+    """
+    import jax
+    if jax.process_count() <= 1:
+        return True
+    from jax.experimental import multihost_utils
+    items = fence_items(config, train_set)
+    local = np.stack([_digest(v) for _n, v in items])       # [n, 2] u32
+    gathered = np.asarray(multihost_utils.process_allgather(local))
+    if gathered.ndim == 2:                                   # [P*n, 2] form
+        gathered = gathered.reshape(-1, local.shape[0], 2)
+    mismatched = [i for i in range(len(items))
+                  if not (gathered[:, i] == gathered[0, i]).all()]
+    nproc = gathered.shape[0]
+    if not mismatched:
+        log.info(f"consistency fence passed across {nproc} processes "
+                 f"({len(items)} fields verified)")
+        return True
+    lines = []
+    for i in mismatched:
+        digests = " ".join(
+            "rank%d=%08x%08x" % (r, gathered[r, i, 0], gathered[r, i, 1])
+            for r in range(nproc))
+        lines.append(f"  {items[i][0]}: {digests}")
+    msg = ("pre-training consistency fence FAILED: ranks disagree on "
+           f"{len(mismatched)} field(s); training would silently corrupt "
+           "the histogram psum (parallel/dist_data.py invariant). "
+           "Mismatched fields:\n" + "\n".join(lines))
+    if raise_on_mismatch:
+        log.fatal(msg)
+    log.warning(msg)
+    return False

@@ -19,6 +19,30 @@ from ..utils import log
 DATA_AXIS = "data"
 
 
+def shard_map_compat(f, *, mesh, in_specs, out_specs, check_vma=False):
+    """``shard_map`` across jax versions: newer jax exposes ``jax.shard_map``
+    with a ``check_vma=`` kwarg; older releases only ship
+    ``jax.experimental.shard_map.shard_map`` where the same switch is spelled
+    ``check_rep=``. Resolve whichever exists and translate the kwarg."""
+    sm = getattr(jax, "shard_map", None)
+    if sm is not None:
+        return sm(f, mesh=mesh, in_specs=in_specs, out_specs=out_specs,
+                  check_vma=check_vma)
+    from jax.experimental.shard_map import shard_map as sm
+    try:
+        return sm(f, mesh=mesh, in_specs=in_specs, out_specs=out_specs,
+                  check_rep=check_vma)
+    except TypeError:
+        return sm(f, mesh=mesh, in_specs=in_specs, out_specs=out_specs)
+
+
+def mesh_context(mesh: Mesh):
+    """Ambient-mesh activation across jax versions: ``jax.set_mesh`` where it
+    exists; older jax makes the ``Mesh`` object itself the context manager."""
+    set_mesh = getattr(jax, "set_mesh", None)
+    return set_mesh(mesh) if set_mesh is not None else mesh
+
+
 def make_mesh(num_devices: Optional[int] = None, axis_name: str = DATA_AXIS,
               devices: Optional[Sequence] = None) -> Mesh:
     """1-D data-parallel mesh over the available devices."""
@@ -99,7 +123,20 @@ def init_distributed(config) -> bool:
         # Applied unconditionally so the 120-minute default is honored too
         # (jax's own default is only ~5 minutes)
         kwargs["initialization_timeout"] = int(config.time_out) * 60
-    jax.distributed.initialize(**kwargs)
+    # transient bootstrap failures (coordinator not yet listening, DNS
+    # hiccup) retry with backoff — the reference's socket linkers likewise
+    # retry Connect inside a timeout loop (linkers_socket.cpp:171-224)
+    from ..utils import faults
+    from ..utils.retry import call_with_backoff
+
+    def _init_once():
+        faults.fault_point("dist_init")
+        jax.distributed.initialize(**kwargs)
+
+    call_with_backoff(_init_once,
+                      attempts=max(1, int(getattr(config, "network_retries",
+                                                  3))),
+                      base_delay=0.5, name="jax.distributed.initialize")
     _DISTRIBUTED_INITIALIZED = True
     log.info(f"jax.distributed initialized: process {jax.process_index()} "
              f"of {jax.process_count()} ({jax.device_count()} devices)")

@@ -1,0 +1,137 @@
+"""Fault-injection harness.
+
+Named fault points are compiled into the hot paths of this package
+(``snapshot_write``, ``mapper_allgather``, ``dist_init``, ``tree_update``)
+and are inert unless armed. Arming happens via the ``LGBMTPU_FAULTS`` env var
+or the ``faults`` parameter, with the spec syntax::
+
+    LGBMTPU_FAULTS="snapshot_write:2,mapper_allgather:1"
+
+meaning: the first 2 hits of ``snapshot_write`` raise :class:`FaultInjected`,
+then it succeeds; ``mapper_allgather`` fails once.  A count of ``-1`` (or
+``*``) fails forever — that is how the kill-and-resume tests simulate a
+process crash at a chosen iteration (``tree_update:0`` arms nothing;
+``tree_update@5`` skips 5 hits then fails forever, i.e. "crash at the 6th
+boosting iteration").
+
+The harness exists so the retry / atomic-write / resume machinery can be
+*proven* under failure in CPU-fast tests instead of trusted on faith; the
+reference has no analog (its fault story is "CHECK and die").
+"""
+from __future__ import annotations
+
+import os
+import threading
+from typing import Dict, Optional
+
+from . import log
+
+ENV_VAR = "LGBMTPU_FAULTS"
+
+KNOWN_POINTS = ("snapshot_write", "mapper_allgather", "dist_init",
+                "tree_update")
+
+_lock = threading.Lock()
+# name -> [skip_remaining, fail_remaining]; fail_remaining < 0 = fail forever
+_armed: Dict[str, list] = {}
+_hits: Dict[str, int] = {}
+_env_loaded = False
+
+
+class FaultInjected(RuntimeError):
+    """Raised by an armed fault point (simulated crash/transport error)."""
+
+    def __init__(self, point: str, hit: int):
+        super().__init__(f"injected fault at '{point}' (hit #{hit})")
+        self.point = point
+        self.hit = hit
+
+
+def _parse_spec(spec: str) -> Dict[str, list]:
+    out: Dict[str, list] = {}
+    for part in (spec or "").split(","):
+        part = part.strip()
+        if not part:
+            continue
+        skip = 0
+        name = part
+        count = "1"
+        if ":" in part:
+            name, count = part.split(":", 1)
+        if "@" in name:
+            # name@K -> skip the first K hits, then fail (count times)
+            name, skip_s = name.split("@", 1)
+            skip = int(skip_s)
+            if ":" not in part:
+                count = "-1"
+        name = name.strip()
+        n = -1 if count.strip() in ("-1", "*", "inf") else int(count)
+        if name not in KNOWN_POINTS:
+            log.warning(f"unknown fault point '{name}' "
+                        f"(known: {', '.join(KNOWN_POINTS)}); arming anyway")
+        out[name] = [skip, n]
+    return out
+
+
+def configure(spec: Optional[str]) -> None:
+    """Arm fault points from a spec string (empty/None disarms everything)."""
+    global _env_loaded
+    with _lock:
+        _armed.clear()
+        _hits.clear()
+        _env_loaded = True   # explicit configure overrides the env var
+        if spec:
+            _armed.update(_parse_spec(spec))
+
+
+def reset() -> None:
+    """Disarm all fault points and forget hit counts (test teardown)."""
+    global _env_loaded
+    with _lock:
+        _armed.clear()
+        _hits.clear()
+        _env_loaded = False
+
+
+def _ensure_env_loaded() -> None:
+    global _env_loaded
+    if _env_loaded:
+        return
+    _env_loaded = True
+    spec = os.environ.get(ENV_VAR, "")
+    if spec:
+        _armed.update(_parse_spec(spec))
+        log.info(f"fault injection armed from {ENV_VAR}: {spec}")
+
+
+def fault_point(name: str) -> None:
+    """Hot-path hook: no-op unless ``name`` is armed, else raise
+    :class:`FaultInjected` while the armed count lasts."""
+    with _lock:
+        _ensure_env_loaded()
+        state = _armed.get(name)
+        _hits[name] = _hits.get(name, 0) + 1
+        if state is None:
+            return
+        if state[0] > 0:        # still skipping
+            state[0] -= 1
+            return
+        if state[1] == 0:       # exhausted: succeed from now on
+            return
+        if state[1] > 0:
+            state[1] -= 1
+        hit = _hits[name]
+    raise FaultInjected(name, hit)
+
+
+def hits(name: str) -> int:
+    """How many times a fault point was reached (armed or not)."""
+    with _lock:
+        return _hits.get(name, 0)
+
+
+def is_armed(name: str) -> bool:
+    with _lock:
+        _ensure_env_loaded()
+        s = _armed.get(name)
+        return bool(s and (s[0] > 0 or s[1] != 0))

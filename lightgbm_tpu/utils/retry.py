@@ -1,0 +1,52 @@
+"""Retry with exponential backoff.
+
+Reference analog: the socket linkers retry transient connect failures instead
+of dying on the first error (src/network/linkers_socket.cpp:171-224 retries
+``Connect`` inside a timeout loop). Here the same policy wraps the
+jax.distributed bootstrap and the mapper allgather (parallel/mesh.py,
+parallel/dist_data.py), and tests reuse it for the coordinator-port
+bind/release race (tests/test_multiprocess.py).
+"""
+from __future__ import annotations
+
+import time
+from typing import Callable, Iterable, Optional, Tuple, Type
+
+from . import log
+
+
+def backoff_delays(attempts: int, base_delay: float = 0.1,
+                   max_delay: float = 30.0, factor: float = 2.0):
+    """Yield ``attempts - 1`` exponentially growing sleep durations.
+
+    Deterministic (no jitter) so fault-injection tests can assert exact
+    retry counts; the cap keeps multi-host stragglers from sleeping forever.
+    """
+    d = base_delay
+    for _ in range(max(attempts - 1, 0)):
+        yield min(d, max_delay)
+        d *= factor
+
+
+def call_with_backoff(fn: Callable, *, attempts: int = 3,
+                      base_delay: float = 0.1, max_delay: float = 30.0,
+                      retry_on: Tuple[Type[BaseException], ...] = (Exception,),
+                      name: Optional[str] = None,
+                      sleep: Callable[[float], None] = time.sleep):
+    """Call ``fn()``; on a ``retry_on`` exception retry with exponential
+    backoff, re-raising the last error once ``attempts`` are exhausted."""
+    what = name or getattr(fn, "__name__", "operation")
+    delays = list(backoff_delays(attempts, base_delay, max_delay))
+    last: Optional[BaseException] = None
+    for i in range(max(attempts, 1)):
+        try:
+            return fn()
+        except retry_on as e:   # noqa: PERF203 - retry loop by design
+            last = e
+            if i >= len(delays):
+                break
+            log.warning(f"{what} failed ({type(e).__name__}: {e}); "
+                        f"retry {i + 1}/{attempts - 1} in {delays[i]:.2f}s")
+            sleep(delays[i])
+    assert last is not None
+    raise last

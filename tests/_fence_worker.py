@@ -1,0 +1,78 @@
+"""Worker for test_zz_fence_multiprocess.py — two jax.distributed processes
+exercise the pre-training consistency fence (lightgbm_tpu/parallel/fence.py)
+with genuinely divergent state, then with matching state."""
+import os
+import sys
+
+import numpy as np
+
+os.environ.setdefault("JAX_PLATFORMS", "cpu")
+
+import jax  # noqa: E402
+
+# same CPU/gloo bootstrap as tests/_mp_worker.py
+jax.config.update("jax_platforms", "cpu")
+jax.config.update("jax_cpu_collectives_implementation", "gloo")
+
+sys.path.insert(0, "/root/repo")
+
+from lightgbm_tpu.config import Config  # noqa: E402
+from lightgbm_tpu.binning import BinMapper  # noqa: E402
+from lightgbm_tpu.parallel.fence import consistency_fence  # noqa: E402
+from lightgbm_tpu.parallel.mesh import init_distributed  # noqa: E402
+from lightgbm_tpu.utils import log  # noqa: E402
+
+
+class _Shim:
+    """Minimal train_set stand-in carrying only the fence-relevant fields."""
+
+    def __init__(self, rank_offset: float):
+        self.mappers = [
+            BinMapper(num_bins=4,
+                      upper_bounds=np.array([0.5 + rank_offset, 1.5, np.inf])),
+            BinMapper(num_bins=3, upper_bounds=np.array([2.0, np.inf])),
+        ]
+        self.feature_map = np.arange(2, dtype=np.int64)
+        self.num_features = 2
+
+
+def main():
+    port = sys.argv[1]
+    conf = Config({"num_machines": 2,
+                   "machines": f"127.0.0.1:{port},127.0.0.1:0"})
+    init_distributed(conf)
+    assert jax.process_count() == 2, jax.process_count()
+    rank = jax.process_index()
+
+    captured = []
+    log.set_callback(lambda line: captured.append(line))
+
+    # ---- divergent config AND divergent mappers: fence must fail naming
+    # exactly the fields that differ, before any training collective ----
+    bad_conf = Config({"learning_rate": 0.1 + 0.05 * rank})
+    ok = consistency_fence(bad_conf, _Shim(rank_offset=0.1 * rank),
+                           raise_on_mismatch=False)
+    assert ok is False, "fence passed on divergent state"
+    blob = "".join(captured)
+    assert "config.learning_rate" in blob, blob
+    assert "data.bin_mappers" in blob, blob
+    assert "config.num_leaves" not in blob, \
+        f"fence flagged a field that matches: {blob}"
+
+    # ---- raising path: the default aborts with LightGBMError ----
+    try:
+        consistency_fence(bad_conf, _Shim(rank_offset=0.1 * rank))
+        raise AssertionError("fence did not raise on divergent state")
+    except log.LightGBMError as e:
+        assert "config.learning_rate" in str(e), str(e)
+
+    # ---- matching state on both ranks: fence passes ----
+    good_conf = Config({"learning_rate": 0.2})
+    assert consistency_fence(good_conf, _Shim(rank_offset=0.0)) is True
+
+    log.set_callback(None)
+    print(f"FENCE_WORKER_OK rank={rank}")
+
+
+if __name__ == "__main__":
+    main()

@@ -1,0 +1,69 @@
+"""Shared helpers for the 2-process jax.distributed tests.
+
+``_free_port()`` has an inherent bind/release race: the port can be stolen
+between ``close()`` and the coordinator's bind. Instead of pretending the
+race away, ``spawn_two_ranks`` retries the WHOLE 2-process spawn on a fresh
+port when the workers die with an address-in-use error, reusing the
+package's backoff helper (lightgbm_tpu/utils/retry.py).
+"""
+import os
+import socket
+import subprocess
+import sys
+import time
+
+_ADDR_IN_USE_MARKERS = ("address already in use", "address in use",
+                        "errno 98", "eaddrinuse")
+
+
+def free_port() -> int:
+    s = socket.socket()
+    s.bind(("127.0.0.1", 0))
+    port = s.getsockname()[1]
+    s.close()
+    return port
+
+
+def _looks_like_port_clash(outs) -> bool:
+    return any(m in out.lower() for out in outs for m in _ADDR_IN_USE_MARKERS)
+
+
+def run_two_ranks(worker_args, timeout=480, cwd="/root/repo"):
+    """Spawn rank 0/1 subprocesses running ``worker_args(port)``; returns
+    (procs, outs) after both exit."""
+    port = free_port()
+    env_base = {k: v for k, v in os.environ.items() if k not in ("XLA_FLAGS",)}
+    env_base["JAX_PLATFORMS"] = "cpu"
+    procs = []
+    for rank in range(2):
+        env = dict(env_base)
+        env["JAX_PROCESS_ID"] = str(rank)
+        procs.append(subprocess.Popen(
+            [sys.executable] + worker_args(port), env=env,
+            stdout=subprocess.PIPE, stderr=subprocess.STDOUT, cwd=cwd))
+    outs = []
+    for p in procs:
+        try:
+            out, _ = p.communicate(timeout=timeout)
+        except subprocess.TimeoutExpired:
+            for q in procs:
+                q.kill()
+            raise
+        outs.append(out.decode("utf-8", "replace"))
+    return procs, outs
+
+
+def spawn_two_ranks(worker_args, timeout=480, attempts=3, cwd="/root/repo"):
+    """run_two_ranks with address-in-use retry on a fresh port each attempt."""
+    import sys as _sys
+    _sys.path.insert(0, cwd)
+    from lightgbm_tpu.utils.retry import backoff_delays
+    delays = list(backoff_delays(attempts, base_delay=0.5)) + [0.0]
+    for attempt in range(attempts):
+        procs, outs = run_two_ranks(worker_args, timeout=timeout, cwd=cwd)
+        failed = any(p.returncode != 0 for p in procs)
+        if failed and _looks_like_port_clash(outs) and attempt < attempts - 1:
+            time.sleep(delays[attempt])
+            continue
+        return procs, outs
+    return procs, outs

@@ -6,50 +6,26 @@ run distributed bin finding (dataset_loader.cpp:957-1040), assert the
 allgathered mappers are IDENTICAL on both ranks, and run one data-parallel
 tree-growing step over the global 2-process mesh asserting both ranks build
 the same tree.
+
+The worker spawn goes through _mp_util.spawn_two_ranks, which retries the
+whole 2-process launch on a fresh port when the coordinator loses the
+_free_port bind/release race (address-in-use).
 """
 import os
-import socket
-import subprocess
-import sys
 
 import pytest
 
+from _mp_util import spawn_two_ranks
+
 _WORKER = os.path.join(os.path.dirname(__file__), "_mp_worker.py")
-
-
-def _free_port() -> int:
-    s = socket.socket()
-    s.bind(("127.0.0.1", 0))
-    port = s.getsockname()[1]
-    s.close()
-    return port
 
 
 def test_two_process_distributed_load_and_train():
     data = "/root/reference/examples/binary_classification/binary.test"
     if not os.path.exists(data):
         pytest.skip("reference example data unavailable")
-    port = _free_port()
-    env_base = {k: v for k, v in os.environ.items()
-                if k not in ("XLA_FLAGS",)}
-    env_base["JAX_PLATFORMS"] = "cpu"
-    procs = []
-    for rank in range(2):
-        env = dict(env_base)
-        env["JAX_PROCESS_ID"] = str(rank)
-        procs.append(subprocess.Popen(
-            [sys.executable, _WORKER, str(port), data],
-            env=env, stdout=subprocess.PIPE, stderr=subprocess.STDOUT,
-            cwd="/root/repo"))
-    outs = []
-    for p in procs:
-        try:
-            out, _ = p.communicate(timeout=480)
-        except subprocess.TimeoutExpired:
-            for q in procs:
-                q.kill()
-            raise
-        outs.append(out.decode("utf-8", "replace"))
+    procs, outs = spawn_two_ranks(
+        lambda port: [_WORKER, str(port), data], timeout=480)
     for rank, (p, out) in enumerate(zip(procs, outs)):
         assert p.returncode == 0, f"rank {rank} failed:\n{out[-4000:]}"
         assert "MP_WORKER_OK" in out, f"rank {rank} no OK marker:\n{out[-4000:]}"

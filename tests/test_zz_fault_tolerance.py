@@ -1,0 +1,441 @@
+"""Fault-tolerance suite: crash-safe snapshots, lossless resume, non-finite
+guards, and the fault-injection harness (ISSUE: robustness tentpole).
+
+The headline assertion is kill-and-resume BYTE-IDENTITY: a run crashed by an
+injected ``tree_update`` fault at iteration 7 and resumed from its newest
+snapshot produces the exact same model text as the uninterrupted run — with
+bagging and feature_fraction on, so every RNG stream must survive the round
+trip (snapshot.py sidecar, gbdt.get_resume_state/set_resume_state).
+
+Named ``test_zz_*`` so these (moderately training-heavy) tests sort to the
+tail of the alphabetical tier-1 run, after the fast suites.
+"""
+import json
+import os
+
+import numpy as np
+import pytest
+from sklearn.datasets import make_classification, make_regression
+
+import lightgbm_tpu as lgb
+from lightgbm_tpu import snapshot as snap
+from lightgbm_tpu.config import Config
+from lightgbm_tpu.utils import atomic_io, faults, log
+from lightgbm_tpu.utils.faults import FaultInjected
+from lightgbm_tpu.utils.retry import backoff_delays, call_with_backoff
+
+_P = {"verbosity": -1, "num_leaves": 7, "min_data_in_leaf": 5}
+
+
+@pytest.fixture(autouse=True)
+def _disarm_faults():
+    faults.reset()
+    yield
+    faults.reset()
+
+
+def _train_small(rounds=3, **extra):
+    X, y = make_regression(n_samples=300, n_features=6, noise=1.0,
+                           random_state=0)
+    return lgb.train({**_P, "objective": "regression", **extra},
+                     lgb.Dataset(X, label=y), num_boost_round=rounds)
+
+
+# ---------------- retry helper ----------------
+
+def test_backoff_delays_deterministic():
+    assert list(backoff_delays(4, base_delay=0.1, max_delay=0.25)) \
+        == [0.1, 0.2, 0.25]
+    assert list(backoff_delays(1)) == []
+
+
+def test_call_with_backoff_retries_then_succeeds():
+    calls = []
+
+    def flaky():
+        calls.append(1)
+        if len(calls) < 3:
+            raise OSError("transient")
+        return "ok"
+
+    slept = []
+    assert call_with_backoff(flaky, attempts=3, base_delay=0.1,
+                             sleep=slept.append) == "ok"
+    assert len(calls) == 3 and slept == [0.1, 0.2]
+
+
+def test_call_with_backoff_reraises_last():
+    def always():
+        raise ValueError("permanent")
+
+    with pytest.raises(ValueError, match="permanent"):
+        call_with_backoff(always, attempts=2, base_delay=0.0,
+                          sleep=lambda _d: None)
+
+
+# ---------------- fault harness ----------------
+
+@pytest.mark.faults
+def test_fault_spec_counts():
+    faults.configure("snapshot_write:2")
+    for _ in range(2):
+        with pytest.raises(FaultInjected):
+            faults.fault_point("snapshot_write")
+    faults.fault_point("snapshot_write")     # count exhausted: succeeds
+    assert faults.hits("snapshot_write") == 3
+    assert not faults.is_armed("snapshot_write")
+
+
+@pytest.mark.faults
+def test_fault_skip_then_fail_forever():
+    faults.configure("tree_update@2")
+    faults.fault_point("tree_update")
+    faults.fault_point("tree_update")        # first 2 hits skipped
+    for _ in range(3):
+        with pytest.raises(FaultInjected):
+            faults.fault_point("tree_update")
+
+
+@pytest.mark.faults
+def test_fault_env_arming(monkeypatch):
+    monkeypatch.setenv(faults.ENV_VAR, "mapper_allgather:1")
+    faults.reset()                           # force a lazy env reload
+    with pytest.raises(FaultInjected) as ei:
+        faults.fault_point("mapper_allgather")
+    assert ei.value.point == "mapper_allgather"
+    faults.fault_point("mapper_allgather")
+
+
+# ---------------- atomic writes ----------------
+
+@pytest.mark.faults
+def test_atomic_write_crash_leaves_no_partial(tmp_path):
+    target = str(tmp_path / "model.txt")
+    atomic_io.atomic_write_text(target, "v1")
+    faults.configure("snapshot_write:1")
+    # the fault fires after the temp write, before the rename: the crash
+    # window the atomic protocol exists for
+    with pytest.raises(FaultInjected):
+        atomic_io.atomic_write_text(target, "partial garbage",
+                                    fault_name="snapshot_write")
+    with open(target) as f:
+        assert f.read() == "v1"              # final path untouched
+    assert [fn for fn in os.listdir(tmp_path) if ".tmp." in fn] == []
+
+
+def test_cleanup_temp_files(tmp_path):
+    orphan = tmp_path / "model.txt.tmp.abc123"
+    orphan.write_text("junk from a crashed writer")
+    (tmp_path / "model.txt").write_text("real")
+    assert atomic_io.cleanup_temp_files(str(tmp_path), "model.txt") == 1
+    assert not orphan.exists()
+    assert (tmp_path / "model.txt").read_text() == "real"
+
+
+def test_save_model_is_atomic_and_loadable(tmp_path):
+    bst = _train_small(3)
+    path = str(tmp_path / "m.txt")
+    bst.save_model(path)
+    assert [fn for fn in os.listdir(tmp_path) if ".tmp." in fn] == []
+    X = make_regression(n_samples=300, n_features=6, noise=1.0,
+                        random_state=0)[0]
+    np.testing.assert_allclose(lgb.Booster(model_file=path).predict(X),
+                               bst.predict(X), rtol=1e-5)
+
+
+# ---------------- snapshots ----------------
+
+@pytest.mark.faults
+def test_snapshot_write_retries_through_faults(tmp_path):
+    bst = _train_small(3)
+    faults.configure("snapshot_write:2")     # first 2 hits fail, then fine
+    path = snap.write_snapshot(bst, str(tmp_path), 3)
+    assert os.path.exists(path)
+    assert os.path.exists(os.path.join(str(tmp_path), snap.state_name(3)))
+    payload = snap.load_latest_valid(str(tmp_path))
+    assert payload is not None and payload.iteration == 3
+
+
+def test_snapshot_retention_keeps_newest(tmp_path):
+    bst = _train_small(2)
+    d = str(tmp_path)
+    for it in range(1, 6):
+        snap.write_snapshot(bst, d, it, keep=2)
+    with open(os.path.join(d, snap.MANIFEST_NAME)) as f:
+        kept = [e["iteration"] for e in json.load(f)["snapshots"]]
+    assert kept == [4, 5]
+    for it in (1, 2, 3):
+        assert not os.path.exists(os.path.join(d, snap.model_name(it)))
+    for it in (4, 5):
+        assert os.path.exists(os.path.join(d, snap.model_name(it)))
+        assert os.path.exists(os.path.join(d, snap.state_name(it)))
+
+
+def test_truncated_snapshot_never_loaded(tmp_path):
+    bst = _train_small(4)
+    d = str(tmp_path)
+    snap.write_snapshot(bst, d, 2)
+    snap.write_snapshot(bst, d, 4)
+    # truncate the newest model text (simulated non-atomic external write)
+    p4 = os.path.join(d, snap.model_name(4))
+    with open(p4) as f:
+        head = f.read(120)
+    with open(p4, "w") as f:
+        f.write(head)
+    payload = snap.load_latest_valid(d)
+    assert payload is not None and payload.iteration == 2
+    # now also truncate the older state sidecar: nothing valid remains
+    s2 = os.path.join(d, snap.state_name(2))
+    with open(s2, "rb") as f:
+        raw = f.read()
+    with open(s2, "wb") as f:
+        f.write(raw[: len(raw) // 2])
+    assert snap.load_latest_valid(d) is None
+
+
+def test_snapshots_land_in_snapshot_dir(tmp_path):
+    d = str(tmp_path / "snaps")
+    X, y = make_regression(n_samples=300, n_features=6, noise=1.0,
+                           random_state=0)
+    lgb.train({**_P, "objective": "regression", "snapshot_freq": 2,
+               "snapshot_dir": d}, lgb.Dataset(X, label=y),
+              num_boost_round=4)
+    assert os.path.exists(os.path.join(d, snap.model_name(2)))
+    assert os.path.exists(os.path.join(d, snap.model_name(4)))
+    assert os.path.exists(os.path.join(d, snap.MANIFEST_NAME))
+    assert not os.path.exists(snap.model_name(2))    # nothing in CWD
+    # default placement follows output_model, not CWD
+    assert snap.snapshot_dir_for(
+        Config({"output_model": "/x/y/model.txt"})) == "/x/y"
+
+
+# ---------------- kill-and-resume ----------------
+
+@pytest.mark.faults
+def test_kill_and_resume_byte_identical(tmp_path):
+    """Crash at iteration 7 via an armed tree_update fault, resume from the
+    iteration-6 snapshot, finish: the final model text must equal the
+    uninterrupted run's byte for byte — bagging + feature_fraction on, so
+    this proves every RNG stream survives the snapshot round trip."""
+    X, y = make_regression(n_samples=500, n_features=8, noise=2.0,
+                           random_state=5)
+    P = {**_P, "objective": "regression", "learning_rate": 0.1,
+         "bagging_fraction": 0.8, "bagging_freq": 1,
+         "feature_fraction": 0.7, "seed": 7}
+    def _model_bytes(bst):
+        # everything up to the parameters echo: header, trees, feature
+        # importances. The echo legitimately differs (the resumed run
+        # records its snapshot_dir/snapshot_freq); the MODEL must not.
+        return bst.model_to_string().split("\nparameters:\n")[0]
+
+    ref_text = _model_bytes(lgb.train(P, lgb.Dataset(X, label=y),
+                                      num_boost_round=12))
+
+    d = str(tmp_path / "snaps")
+    with pytest.raises(FaultInjected):
+        lgb.train({**P, "snapshot_freq": 2, "snapshot_dir": d,
+                   "faults": "tree_update@7"},
+                  lgb.Dataset(X, label=y), num_boost_round=12)
+    faults.reset()
+    latest = snap.load_latest_valid(d)
+    assert latest is not None and latest.iteration == 6
+
+    bst = lgb.train({**P, "snapshot_freq": 2, "snapshot_dir": d},
+                    lgb.Dataset(X, label=y), num_boost_round=12,
+                    resume_from_snapshot=d)
+    assert bst.current_iteration == 12
+    assert _model_bytes(bst) == ref_text
+
+
+def test_resume_from_empty_dir_trains_from_scratch(tmp_path):
+    d = str(tmp_path / "nothing")
+    captured = []
+    log.set_callback(captured.append)
+    try:
+        X, y = make_regression(n_samples=300, n_features=6, noise=1.0,
+                               random_state=0)
+        # verbosity 0 = warnings on (verbosity -1 would silence the
+        # "no valid snapshot" line this test is about)
+        bst = lgb.train({**_P, "objective": "regression", "verbosity": 0},
+                        lgb.Dataset(X, label=y), num_boost_round=5,
+                        resume_from_snapshot=d)
+    finally:
+        log.set_callback(None)
+    assert bst.current_iteration == 5
+    assert any("no valid snapshot" in line for line in captured)
+
+
+def test_resume_config_mismatch_falls_back_to_scratch(tmp_path):
+    d = str(tmp_path)
+    X, y = make_regression(n_samples=300, n_features=6, noise=1.0,
+                           random_state=0)
+    lgb.train({**_P, "objective": "regression", "learning_rate": 0.1,
+               "snapshot_freq": 2, "snapshot_dir": d},
+              lgb.Dataset(X, label=y), num_boost_round=4)
+    captured = []
+    log.set_callback(captured.append)
+    try:
+        # a different learning_rate invalidates the snapshot fingerprint:
+        # resume must refuse (naming the field) and train from scratch
+        bst = lgb.train({**_P, "objective": "regression", "verbosity": 0,
+                         "learning_rate": 0.3},
+                        lgb.Dataset(X, label=y), num_boost_round=4,
+                        resume_from_snapshot=d)
+    finally:
+        log.set_callback(None)
+    assert bst.current_iteration == 4
+    assert any("cannot resume" in line and "learning_rate" in line
+               for line in captured)
+
+
+def test_early_stopping_survives_resume(tmp_path):
+    """best_iteration must not regress across a snapshot/resume boundary:
+    the early-stopping closure state rides the snapshot (callback.py
+    _es_export/_es_import), so the resumed run stops at the same best."""
+    X, y = make_classification(n_samples=600, n_features=10, random_state=3,
+                               flip_y=0.3)
+    Xt, Xv = X[:450], X[450:]
+    yt, yv = y[:450], y[450:]
+    P = {**_P, "objective": "binary", "metric": "binary_logloss",
+         "learning_rate": 0.3, "seed": 11}
+    d = str(tmp_path / "snaps")
+
+    def _run(resume):
+        ds = lgb.Dataset(Xt, label=yt)
+        kw = {"resume_from_snapshot": d} if resume else {}
+        return lgb.train({**P, "snapshot_freq": 2, "snapshot_dir": d}, ds,
+                         num_boost_round=100,
+                         valid_sets=[ds.create_valid(Xv, label=yv)],
+                         early_stopping_rounds=5, verbose_eval=False, **kw)
+
+    full = _run(resume=False)
+    assert full.best_iteration > 0, "test premise: early stopping triggered"
+    resumed = _run(resume=True)
+    assert resumed.best_iteration == full.best_iteration
+
+
+# ---------------- non-finite guards ----------------
+
+def _nan_fobj(nan_from, rows=None):
+    """Custom objective that turns non-finite at call #``nan_from`` —
+    every row by default, or just the first ``rows`` (the partial-poison
+    form keeps enough signal for the clip policy to keep training)."""
+    state = {"n": 0}
+
+    def fobj(preds, ds):
+        state["n"] += 1
+        y = np.asarray(ds.label, dtype=np.float64)
+        g = np.asarray(preds, dtype=np.float64) - y
+        h = np.ones_like(g)
+        if state["n"] >= nan_from:
+            if rows is None:
+                g = g + np.nan
+            else:
+                g[:rows] = np.nan
+        return g, h
+
+    return fobj
+
+
+def _nf_data():
+    X, y = make_regression(n_samples=300, n_features=6, noise=1.0,
+                           random_state=1)
+    return lgb.Dataset(X, label=y)
+
+
+def test_nonfinite_fatal_aborts():
+    with pytest.raises(log.LightGBMError, match="non-finite"):
+        lgb.train({**_P, "objective": "none", "nonfinite_policy": "fatal"},
+                  _nf_data(), num_boost_round=6, fobj=_nan_fobj(3))
+
+
+def test_nonfinite_warn_skip_tree_drops_iterations():
+    captured = []
+    log.set_callback(captured.append)
+    try:
+        bst = lgb.train({**_P, "objective": "none", "verbosity": 0,
+                         "nonfinite_policy": "warn_skip_tree"},
+                        _nf_data(), num_boost_round=6, fobj=_nan_fobj(3))
+    finally:
+        log.set_callback(None)
+    assert bst.current_iteration == 6
+    assert bst.num_trees() == 2              # iterations 3..6 discarded
+    assert any("skipping this iteration" in line for line in captured)
+
+
+def test_nonfinite_clip_completes_finite():
+    # poison a handful of rows only: clip zeroes them and the remaining
+    # signal keeps every iteration growing a real tree
+    bst = lgb.train({**_P, "objective": "none", "nonfinite_policy": "clip"},
+                    _nf_data(), num_boost_round=6,
+                    fobj=_nan_fobj(3, rows=5))
+    assert bst.num_trees() == 6
+    X = make_regression(n_samples=300, n_features=6, noise=1.0,
+                        random_state=1)[0]
+    assert np.isfinite(bst.predict(X)).all()
+
+
+def _nan_feval(score, ds):
+    return [("explodes", float("nan"), False)]
+
+
+def test_nonfinite_eval_fatal_names_metric():
+    ds = _nf_data()
+    with pytest.raises(log.LightGBMError) as ei:
+        lgb.train({**_P, "objective": "regression",
+                   "nonfinite_policy": "fatal"},
+                  ds, num_boost_round=3, valid_sets=[ds],
+                  feval=_nan_feval, verbose_eval=False)
+    assert "explodes" in str(ei.value)
+
+
+def test_nonfinite_eval_warn_once():
+    ds = _nf_data()
+    captured = []
+    log.set_callback(captured.append)
+    try:
+        bst = lgb.train({**_P, "objective": "regression", "verbosity": 0,
+                         "nonfinite_policy": "warn_skip_tree"},
+                        ds, num_boost_round=4, valid_sets=[ds],
+                        feval=_nan_feval, verbose_eval=False)
+    finally:
+        log.set_callback(None)
+    assert bst.num_trees() == 4
+    warns = [line for line in captured if "non-finite eval value" in line]
+    assert len(warns) == 1                   # warned once, not per iteration
+
+
+# ---------------- fence (single process) + vfs ----------------
+
+def test_fence_single_process_trivially_passes():
+    from lightgbm_tpu.parallel.fence import consistency_fence, fence_items
+    conf = Config({})
+    assert consistency_fence(conf, None) is True
+    names = [n for n, _v in fence_items(conf, None)]
+    assert len(names) == len(set(names))
+    assert "data.bin_mappers" in names and "config.learning_rate" in names
+
+
+def test_vfs_exists_distinguishes_transport_errors(tmp_path):
+    from lightgbm_tpu.io import vfs
+
+    def opener(path, mode):
+        if "gone" in path:
+            raise FileNotFoundError(path)
+        raise RuntimeError("flaky transport")
+
+    vfs.register_scheme("faketst", opener)
+    captured = []
+    log.set_callback(captured.append)
+    try:
+        assert vfs.exists("faketst://bucket/gone.txt") is False
+        assert captured == []                # clean not-found stays silent
+        assert vfs.exists("faketst://bucket/err.txt") is False
+        assert any("transport error" in line for line in captured)
+    finally:
+        log.set_callback(None)
+    # local paths take the os.path fast path (no opener involved)
+    real = tmp_path / "f.txt"
+    real.write_text("x")
+    assert vfs.exists(str(real)) is True
+    assert vfs.exists(str(tmp_path / "missing.txt")) is False

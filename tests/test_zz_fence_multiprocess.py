@@ -1,0 +1,22 @@
+"""2-process consistency-fence test (parallel/fence.py).
+
+Two real jax.distributed processes build DIVERGENT training state (different
+learning_rate, different bin-mapper boundaries) and assert the pre-training
+fence fails fast naming exactly the mismatched fields, then passes once the
+state matches. Named ``test_zz_*`` so the heavy 2-process spawn sorts to the
+tail of the alphabetical tier-1 run, after the fast suites.
+"""
+import os
+
+from _mp_util import spawn_two_ranks
+
+_WORKER = os.path.join(os.path.dirname(__file__), "_fence_worker.py")
+
+
+def test_two_process_consistency_fence():
+    procs, outs = spawn_two_ranks(lambda port: [_WORKER, str(port)],
+                                  timeout=300)
+    for rank, (p, out) in enumerate(zip(procs, outs)):
+        assert p.returncode == 0, f"rank {rank} failed:\n{out[-4000:]}"
+        assert "FENCE_WORKER_OK" in out, \
+            f"rank {rank} no OK marker:\n{out[-4000:]}"
