@@ -147,6 +147,23 @@ def main():
         "train_auc": round(auc, 4),
         **({"ref_auc": round(ref_auc, 4)} if ref_auc is not None else {}),
     }
+    # surface the serving headline recorded by bench_predict.py, so one
+    # bench.py line carries both trajectories (train + predict)
+    predict_path = os.path.join(os.path.dirname(os.path.abspath(__file__)),
+                                "PREDICT_BENCH.json")
+    if os.path.exists(predict_path):
+        with open(predict_path) as fh:
+            pdoc = json.load(fh)
+        big = max(pdoc.get("entries", []),
+                  key=lambda e: e.get("batch_rows", 0), default=None)
+        if big:
+            result["predict_bench"] = {
+                "backend": pdoc.get("backend"),
+                "batch_rows": big["batch_rows"],
+                "rows_per_sec": big["transformed_rows_per_sec"],
+                **({"vs_ref_cli": pdoc["vs_ref_cli"]}
+                   if "vs_ref_cli" in pdoc else {}),
+            }
     # surface the 500-iteration parity headline (scripts/parity_bench.py)
     if par.get("tpu_valid_auc"):
         result["parity_500iter"] = {

@@ -1,0 +1,203 @@
+"""Predict benchmark: serving throughput of the PredictEngine (serving.py).
+
+Trains (or loads) a HIGGS-shaped model, then measures steady-state rows/sec
+through ``Booster.predict`` at batch sizes {10M, 100k, 1k, 1}, raw and
+transformed, after a per-bucket warmup — the serving analog of bench.py's
+training throughput. Also reports the engine's chunked-streaming stats at
+10M rows and, when a reference LightGBM CLI binary is available (or numbers
+were previously recorded into PREDICT_BENCH.json by a run with ``--ref-cli``),
+the reference ``task=predict`` rows/sec on identical data.
+
+Prints ONE JSON line (like bench.py); ``--out PREDICT_BENCH.json`` writes the
+full document that the repo commits so the serving trajectory is tracked
+across rounds.
+
+Usage:
+  python bench_predict.py                         # default batch set
+  python bench_predict.py --rows 1000000          # cap the largest batch
+  python bench_predict.py --out PREDICT_BENCH.json
+  python bench_predict.py --ref-cli .refbuild/lightgbm   # also time the CLI
+
+Env overrides: LGBM_TPU_PREDICT_BENCH_ROWS, LGBM_TPU_PREDICT_BENCH_ITERS,
+LGBM_TPU_PREDICT_BENCH_LEAVES, LGBM_TPU_PREDICT_BENCH_REPEATS.
+"""
+import argparse
+import json
+import os
+import subprocess
+import sys
+import tempfile
+import time
+
+import numpy as np
+
+REPO = os.path.dirname(os.path.abspath(__file__))
+DEFAULT_BATCHES = (10_000_000, 100_000, 1_000, 1)
+
+
+def _train_model(n_rows, n_iters, num_leaves, max_bin):
+    import lightgbm_tpu as lgb
+    from bench import synth_higgs
+    X, y = synth_higgs(n_rows, seed=0)
+    params = {"objective": "binary", "num_leaves": num_leaves,
+              "max_bin": max_bin, "learning_rate": 0.1,
+              "min_data_in_leaf": 20, "verbosity": -1}
+    ds = lgb.Dataset(X, label=y, params=params)
+    booster = lgb.train(params, ds, num_boost_round=n_iters)
+    return booster
+
+
+def _time_predict(booster, X, raw_score, repeats):
+    """Median wall time over ``repeats`` steady-state calls (post-warmup)."""
+    booster.predict(X[: X.shape[0]], raw_score=raw_score)  # warmup bucket
+    times = []
+    for _ in range(repeats):
+        t0 = time.perf_counter()
+        out = booster.predict(X, raw_score=raw_score)
+        times.append(time.perf_counter() - t0)
+    assert np.all(np.isfinite(out))
+    return float(np.median(times))
+
+
+def _ref_cli_predict(ref_cli, booster, X, workdir):
+    """Time the reference CLI's task=predict on identical data. Returns None
+    when the binary is absent (this container does not ship it); a run on the
+    bench host with --ref-cli records real numbers into PREDICT_BENCH.json."""
+    if not os.path.exists(ref_cli):
+        return None
+    model_path = os.path.join(workdir, "model.txt")
+    data_path = os.path.join(workdir, "pred.tsv")
+    out_path = os.path.join(workdir, "ref_out.tsv")
+    booster.save_model(model_path)
+    np.savetxt(data_path, np.column_stack([np.zeros(X.shape[0]), X]),
+               delimiter="\t", fmt="%.9g")
+    conf = os.path.join(workdir, "predict.conf")
+    with open(conf, "w") as fh:
+        fh.write(f"task=predict\ndata={data_path}\n"
+                 f"input_model={model_path}\noutput_result={out_path}\n")
+    t0 = time.perf_counter()
+    subprocess.run([ref_cli, f"config={conf}"], check=True,
+                   stdout=subprocess.DEVNULL, stderr=subprocess.DEVNULL)
+    dt = time.perf_counter() - t0
+    return {"rows": int(X.shape[0]), "time_s": round(dt, 3),
+            "rows_per_sec": round(X.shape[0] / dt, 1),
+            "note": "CLI end-to-end: parse + predict + write"}
+
+
+def main():
+    ap = argparse.ArgumentParser(description=__doc__.splitlines()[0])
+    ap.add_argument("--rows", type=int,
+                    default=int(os.environ.get(
+                        "LGBM_TPU_PREDICT_BENCH_ROWS", DEFAULT_BATCHES[0])),
+                    help="largest predict batch size (default 10M)")
+    ap.add_argument("--train-rows", type=int,
+                    default=int(os.environ.get(
+                        "LGBM_TPU_PREDICT_BENCH_TRAIN_ROWS", 1_000_000)),
+                    help="training rows — decoupled from predict batches; "
+                         "the model shape, not the training set, is what "
+                         "predict throughput depends on")
+    ap.add_argument("--iters", type=int,
+                    default=int(os.environ.get(
+                        "LGBM_TPU_PREDICT_BENCH_ITERS", 100)))
+    ap.add_argument("--leaves", type=int,
+                    default=int(os.environ.get(
+                        "LGBM_TPU_PREDICT_BENCH_LEAVES", 255)))
+    ap.add_argument("--bins", type=int, default=63)
+    ap.add_argument("--repeats", type=int,
+                    default=int(os.environ.get(
+                        "LGBM_TPU_PREDICT_BENCH_REPEATS", 3)))
+    ap.add_argument("--ref-cli",
+                    default=os.path.join(REPO, ".refbuild", "lightgbm"))
+    ap.add_argument("--out", default=None,
+                    help="write the full JSON document here "
+                         "(e.g. PREDICT_BENCH.json)")
+    args = ap.parse_args()
+
+    import jax
+    import lightgbm_tpu as lgb  # noqa: F401  (registers compile cache)
+
+    batches = sorted({min(b, args.rows) for b in DEFAULT_BATCHES},
+                     reverse=True)
+    t0 = time.time()
+    booster = _train_model(args.train_rows, args.iters, args.leaves,
+                           args.bins)
+    t_train = time.time() - t0
+    from bench import synth_higgs
+    X, _ = synth_higgs(batches[0], seed=1)   # fresh rows, same distribution
+    print(f"# trained {args.iters} iters on {args.train_rows} rows in "
+          f"{t_train:.1f}s backend={jax.default_backend()}", file=sys.stderr)
+
+    entries = []
+    for n in batches:
+        xb = X[:n]
+        row = {"batch_rows": n}
+        for raw, tag in ((True, "raw"), (False, "transformed")):
+            dt = _time_predict(booster, xb, raw, args.repeats)
+            row[f"{tag}_time_s"] = round(dt, 6)
+            row[f"{tag}_rows_per_sec"] = round(n / max(dt, 1e-9), 1)
+        entries.append(row)
+        print(f"# batch={n} raw={row['raw_rows_per_sec']:,.0f} rows/s "
+              f"transformed={row['transformed_rows_per_sec']:,.0f} rows/s",
+              file=sys.stderr)
+
+    eng = booster._predict_engine
+    engine_stats = {"buckets_compiled": sorted(eng.stats["buckets_seen"]),
+                    "chunk_rows": eng.chunk_rows,
+                    "chunks_streamed": eng.stats["chunks"]}
+
+    with tempfile.TemporaryDirectory() as wd:
+        # reference comparison on the 100k batch (CLI parse of 10M rows of
+        # text dominates its own predict time and takes tens of minutes)
+        ref_n = min(100_000, batches[0])
+        ref = _ref_cli_predict(args.ref_cli, booster, X[:ref_n], wd)
+
+    doc = {
+        "model": {"rows_trained": args.train_rows, "iters": args.iters,
+                  "leaves": args.leaves, "bins": args.bins,
+                  "objective": "binary", "n_features": int(X.shape[1])},
+        "backend": jax.default_backend(),
+        "entries": entries,
+        "engine": engine_stats,
+    }
+    if ref is not None:
+        doc["ref_cli_predict"] = ref
+        big = next(e for e in entries if e["batch_rows"] == ref["rows"])
+        doc["vs_ref_cli"] = round(
+            big["transformed_rows_per_sec"] / ref["rows_per_sec"], 2)
+    else:
+        prior = {}
+        if args.out and os.path.exists(args.out):
+            with open(args.out) as fh:
+                prior = json.load(fh)
+        if prior.get("ref_cli_predict"):
+            # keep previously recorded reference numbers (parity_bench.py
+            # convention: the CLI binary only exists on the bench host)
+            doc["ref_cli_predict"] = prior["ref_cli_predict"]
+            if "vs_ref_cli" in prior:
+                doc["vs_ref_cli"] = prior["vs_ref_cli"]
+        else:
+            doc["ref_cli_predict"] = {
+                "status": "cli_not_available",
+                "invocation": f"python bench_predict.py --ref-cli "
+                              f"{args.ref_cli}"}
+
+    big = entries[0]
+    print(json.dumps({
+        "metric": f"predict_rows_per_sec_higgs"
+                  f"{big['batch_rows'] // 1_000_000}m_l{args.leaves}"
+                  f"_b{args.bins}",
+        "value": big["transformed_rows_per_sec"], "unit": "rows/sec",
+        "raw_rows_per_sec": big["raw_rows_per_sec"],
+        "single_row_latency_ms": round(
+            entries[-1]["transformed_time_s"] * 1e3, 3),
+        **({"vs_ref_cli": doc["vs_ref_cli"]} if "vs_ref_cli" in doc else {}),
+    }))
+    if args.out:
+        with open(args.out, "w") as fh:
+            json.dump(doc, fh, indent=1)
+            fh.write("\n")
+        print(f"# wrote {args.out}", file=sys.stderr)
+
+
+if __name__ == "__main__":
+    main()

@@ -176,11 +176,15 @@ def run_predict(conf: Config, params: Dict) -> None:
     X = pf.X
     if X.shape[1] < nf:  # file sparser than train data (LibSVM tail zeros)
         X = np.pad(X, ((0, 0), (0, nf - X.shape[1])))
+    t0 = time.perf_counter()
     pred = booster.predict(
         X, raw_score=conf.predict_raw_score,
         pred_leaf=conf.predict_leaf_index, pred_contrib=conf.predict_contrib,
         num_iteration=(conf.num_iteration_predict
                        if conf.num_iteration_predict > 0 else None))
+    dt = time.perf_counter() - t0
+    log.info(f"Predicted {X.shape[0]} rows in {dt:.3f}s "
+             f"({X.shape[0] / max(dt, 1e-9):,.0f} rows/s)")
     out = np.asarray(pred)
     if out.ndim == 1:
         out = out[:, None]

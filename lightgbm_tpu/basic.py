@@ -935,35 +935,27 @@ class Booster:
             return base
         if pred_contrib:
             return self._predict_contrib(x, trees, k)
-        # unified exact routing: pseudo-bin the input on the host in f64 and
-        # walk the trees on device with integer compares + categorical bitsets
-        # (io/pseudo_bins.py) — identical for in-session and loaded models
-        from .io.pseudo_bins import PseudoRouter
-        router = getattr(self, "_pseudo_router", None)
-        if router is None or router.n_trees != len(trees):
-            router = PseudoRouter(trees, x.shape[1])
-            router.n_trees = len(trees)
-            self._pseudo_router = router
-        pbins = jax.device_put(router.bin_matrix(x))  # not jnp.asarray: see _finish_device
-        na_dev = jnp.asarray(router.na_id)
-        if pred_leaf:
-            stack_dev = {kk: jnp.asarray(v) for kk, v in router.stack.items()}
-            out = P.leaf_bins_ensemble(stack_dev, pbins, na_dev,
-                                       router.max_steps)
-            return np.asarray(out)
-        # dense path-matrix predictor when no categorical nodes, walk
-        # otherwise (ops/predict.py ensemble_raw_scores). exact_f32:
-        # pseudo-bin ids can exceed 256, past bf16's exact-integer range
-        raw = P.ensemble_raw_scores(
-            router.dense_tables(), router.stack, pbins, na_dev, k,
-            len(trees), self._avg_output(), exact_f32=True,
-            max_steps=router.max_steps)
-        if raw_score:
-            return raw
-        obj = self._objective_for_predict()
-        if obj is not None:
-            return np.asarray(obj.convert_output(jnp.asarray(raw)))
-        return raw
+        # unified exact routing via the persistent serving engine
+        # (serving.py PredictEngine): pseudo-bins the input on the host in
+        # f64 and walks/matmuls the trees on device with integer compares +
+        # categorical bitsets — identical for in-session and loaded models.
+        # Tables live on device across calls; batches are padded to shape
+        # buckets so repeated calls of any size reuse compiled executables.
+        return self._predict_engine_for(trees, x.shape[1], k).predict(
+            x, raw_score=raw_score, pred_leaf=pred_leaf)
+
+    def _predict_engine_for(self, trees, n_features: int, k: int):
+        """Cached PredictEngine for the current tree list; invalidated only
+        on tree-count change (like the old per-Booster PseudoRouter cache —
+        shuffle_models/refit reset it explicitly since they keep the count)."""
+        from .serving import PredictEngine
+        engine = getattr(self, "_predict_engine", None)
+        if engine is None or engine.n_trees != len(trees):
+            engine = PredictEngine(trees, n_features, k, self._avg_output(),
+                                   objective=self._objective_for_predict())
+            self._predict_engine = engine
+            self._pseudo_router = engine.router   # kept for introspection
+        return engine
 
     def _avg_output(self) -> bool:
         if self._gbdt is not None:
@@ -1058,6 +1050,7 @@ class Booster:
             else:
                 score[:, cls] += delta
         new_b._pseudo_router = None
+        new_b._predict_engine = None     # leaf values changed in place
         new_b._attr = dict(self._attr)   # reference: refit copies __attr
         return new_b
 
@@ -1093,6 +1086,8 @@ class Booster:
         self._loaded_meta = meta
         self.trees = trees
         self.best_iteration = -1
+        self._pseudo_router = None
+        self._predict_engine = None  # loaded trees may keep the same count
 
     # ---- introspection ----
     def feature_name(self) -> List[str]:
@@ -1267,7 +1262,10 @@ class Booster:
             "name_valid_sets": list(self.name_valid_sets),
             "pandas_categorical": self.pandas_categorical,
         }
-        state["model_str"] = (self.model_to_string()
+        # serialize ALL trees (num_iteration=-1), not just up to
+        # best_iteration — the copy must predict identically at any
+        # num_iteration (reference: Booster.__getstate__, basic.py:1793)
+        state["model_str"] = (self.model_to_string(num_iteration=-1)
                               if self.num_trees() else None)
         return state
 
@@ -1286,7 +1284,8 @@ class Booster:
         return self.__deepcopy__(None)
 
     def __deepcopy__(self, _memodict):
-        model_str = self.model_to_string() if self.num_trees() else None
+        model_str = (self.model_to_string(num_iteration=-1)
+                     if self.num_trees() else None)
         b = Booster(params=dict(self.params), model_str=model_str)
         b.best_iteration = self.best_iteration
         b.best_score = dict(self.best_score)
@@ -1324,4 +1323,5 @@ class Booster:
         else:
             self.trees = _reorder(trees)
         self._pseudo_router = None   # predict caches tree order
+        self._predict_engine = None  # device tables cache tree order too
         return self

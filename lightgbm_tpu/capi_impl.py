@@ -56,7 +56,12 @@ def predict_for_mat(booster, data_addr: int, nrow: int, ncol: int,
                     out_cap: int) -> int:
     """Dense f64 row-major matrix prediction (reference:
     LGBM_BoosterPredictForMat, c_api.h:822). Returns the number of doubles
-    written, or -1 if out_cap is too small."""
+    written, or -1 if out_cap is too small.
+
+    Goes through the booster's persistent PredictEngine (serving.py), which
+    the handle registry keeps alive across calls: the nrow==1 online-scoring
+    case hits the engine's n=1 shape bucket, so a tight single-row C loop
+    reuses one compiled executable instead of retracing per call."""
     src = (ctypes.c_double * (nrow * ncol)).from_address(data_addr)
     x = np.frombuffer(src, dtype=np.float64).reshape(nrow, ncol)
     out = booster.predict(x, raw_score=bool(raw_score),

@@ -38,7 +38,7 @@ class GBDT:
     name = "gbdt"
     average_output = False
     _needs_grad_for_bag = False   # GOSS samples by |g*h| before growing
-    _supports_fused = True        # RF's running-average scores need the slow path
+    _supports_fused = True        # subclasses opt out (e.g. per-iter resampling)
 
     def __init__(self, config: Config, train_set, objective,
                  metrics: Optional[List] = None):

@@ -38,6 +38,13 @@ from .split import (NEG_INF, SplitParams, SplitResult, best_split,
                     leaf_output, per_feature_gains)
 
 _OOB = 1 << 20  # out-of-bounds scatter index (dropped with mode="drop")
+# minimum static slot width for unrolled levels on the PALLAS path: the
+# fused pass is latency-bound below S=32 (flat 17-22 ms, PERF_NOTES cost
+# table), so levels 0..4 share one padded kernel variant instead of
+# compiling five (S=1,2,4,8,16) that run no faster. At L=255 this cuts the
+# distinct Mosaic variants per grower from 8 to 3 ({32, 64, 127}). The XLA
+# fallback impl pays real per-slot FLOPs, so it is not floored.
+_SLOT_FLOOR = 32
 
 
 class CEGBState(NamedTuple):
@@ -186,7 +193,8 @@ def grow_tree_depthwise(bins: jnp.ndarray, g: jnp.ndarray, h: jnp.ndarray,
 
     # pallas kernels read a transposed bin matrix; build it ONCE per tree (XLA
     # CSEs it across all level passes inside this jit)
-    bins_T = bins.T if H.pick_impl(gp.hist_impl) == "pallas" else None
+    use_pallas = H.pick_impl(gp.hist_impl) == "pallas"
+    bins_T = bins.T if use_pallas else None
     # int8 quantized channels, built once per tree; per-shard scales are fine
     # under data-parallel because every histogram is dequantized to f32 before
     # the psum (each shard contributes real-valued mass)
@@ -530,10 +538,19 @@ def grow_tree_depthwise(bins: jnp.ndarray, g: jnp.ndarray, h: jnp.ndarray,
     # histogram pass cost scales with the slot axis, and a fixed-width while_loop
     # made every level pay for the deepest one (measured ~2x whole-tree cost at
     # L=255). A while_loop tail covers unbalanced growth past the unroll.
+    # On the pallas path slot widths are floored at _SLOT_FLOOR: the fused
+    # pass is latency-bound and flat below S=32 (PERF_NOTES cost table) but
+    # every distinct S compiles its own Mosaic kernel variant, so S in
+    # {1,2,4,8,16} only added compile time (the BENCH_r05 compile
+    # regression). The XLA fallback pays real FLOPs per slot, so it keeps
+    # exact 2^k widths. Selection is unchanged under padding — at level k
+    # the frontier is <= 2^k <= padded S, so `rank < min(budget, SLOTS)`
+    # binds identically and the grown tree is bit-identical.
+    slot_floor = _SLOT_FLOOR if use_pallas else 1
     n_unroll = min(max_levels, max(1, math.ceil(math.log2(max(L - 1, 2)))) + 1)
     last_sel = jnp.int32(1)
     for k in range(n_unroll):
-        slots_k = min(2 ** k, MAX_SLOTS)
+        slots_k = min(MAX_SLOTS, max(2 ** k, slot_floor))
         # early exit: once a level selects no splits OR the leaf budget is
         # exhausted, the tree is finished — skip the remaining unrolled
         # full-data passes. The budget check matters for balanced growth: a
@@ -886,8 +903,11 @@ def grow_tree_depthwise_lean(bins: jnp.ndarray, g, h, c, num_bins, na_bin,
     n_unroll = min(max_levels,
                    max(1, math.ceil(math.log2(max(L - 1, 2)))) + 1)
     last_sel = jnp.int32(1)
+    slot_floor = _SLOT_FLOOR if use_pallas else 1
     for k in range(n_unroll):
-        slots_k = min(2 ** k, MAX_SLOTS)
+        # floored like the default grower: fewer distinct slot widths ->
+        # fewer compiled kernel variants, identical selection (see above)
+        slots_k = min(MAX_SLOTS, max(2 ** k, slot_floor))
         state, last_sel = jax.lax.cond(
             (last_sel > 0) & (state.tree.num_leaves < L),
             lambda st, _s=slots_k, _k=k: level(st, _s, jnp.int32(_k)),

@@ -188,12 +188,17 @@ class LGBMModel:
 
     def predict(self, X, raw_score=False, num_iteration=None, pred_leaf=False,
                 pred_contrib=False, **kwargs):
+        """Predict through the booster's persistent PredictEngine
+        (serving.py): repeated calls of any batch size reuse the
+        device-resident tables and per-bucket compiled executables, so
+        estimator.predict is as cheap as Booster.predict after warmup.
+        Extra kwargs are forwarded to Booster.predict."""
         if self._Booster is None:
             raise ValueError("Estimator not fitted")
         return self._Booster.predict(X, raw_score=raw_score,
                                      num_iteration=num_iteration,
                                      pred_leaf=pred_leaf,
-                                     pred_contrib=pred_contrib)
+                                     pred_contrib=pred_contrib, **kwargs)
 
     @property
     def booster_(self) -> Booster:

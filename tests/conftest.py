@@ -4,6 +4,10 @@ distributed (shard_map) paths are exercised without TPU hardware
 import os
 
 os.environ["JAX_PLATFORMS"] = "cpu"
+# persist even sub-second compiles: the suite lowers thousands of small
+# programs and re-pays their compile time every run with the 1.0 s default
+# (lightgbm_tpu.__init__ reads this knob when it configures the cache)
+os.environ.setdefault("LGBM_TPU_JAX_CACHE_MIN_COMPILE_S", "0.05")
 flags = os.environ.get("XLA_FLAGS", "")
 if "xla_force_host_platform_device_count" not in flags:
     os.environ["XLA_FLAGS"] = (flags + " --xla_force_host_platform_device_count=8").strip()
@@ -16,7 +20,7 @@ import jax
 # the axon TPU plugin ignores JAX_PLATFORMS; force the CPU backend explicitly
 jax.config.update("jax_platforms", "cpu")
 jax.config.update("jax_compilation_cache_dir", "/tmp/jax_cache_lgbm_tpu")
-jax.config.update("jax_persistent_cache_min_compile_time_secs", 1.0)
+jax.config.update("jax_persistent_cache_min_compile_time_secs", 0.05)
 jax.config.update("jax_persistent_cache_enable_xla_caches", "all")
 
 

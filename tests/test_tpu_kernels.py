@@ -15,9 +15,29 @@ import pytest
 _CHECK = os.path.join(os.path.dirname(__file__), "_tpu_kernel_check.py")
 
 
+def _probe_tpu_backend(env, timeout=120):
+    """Bounded backend probe. A TPU plugin that is installed but cannot reach
+    hardware retries its connection for many MINUTES before falling back to
+    CPU (measured ~460 s on a CPU-only box) — most of the tier-1 time budget
+    spent deciding to skip. A healthy attached/tunneled TPU initializes in
+    seconds, so cap the probe and treat a timeout as "no TPU"."""
+    try:
+        proc = subprocess.run(
+            [sys.executable, "-c",
+             "import sys, jax; sys.exit(0 if jax.default_backend() == 'tpu'"
+             " else 3)"],
+            env=env, stdout=subprocess.PIPE, stderr=subprocess.STDOUT,
+            timeout=timeout)
+    except subprocess.TimeoutExpired:
+        return False
+    return proc.returncode == 0
+
+
 def test_compiled_pallas_kernels_on_tpu():
     env = {k: v for k, v in os.environ.items()
            if k not in ("JAX_PLATFORMS", "XLA_FLAGS")}
+    if not _probe_tpu_backend(env):
+        pytest.skip("no TPU backend available (bounded probe)")
     proc = subprocess.run([sys.executable, _CHECK], env=env,
                           stdout=subprocess.PIPE, stderr=subprocess.STDOUT,
                           timeout=900, cwd="/root/repo")
