@@ -38,6 +38,19 @@ def synth_higgs(n_rows: int, n_feat: int = 28, seed: int = 0):
     return X, y
 
 
+def _telemetry_snapshot():
+    """Phase timings + device-memory watermark for the BENCH json (the obs
+    subsystem's bench surface; empty-ish on CPU where memory_stats() is None)."""
+    from lightgbm_tpu import obs
+    from lightgbm_tpu.utils.timer import TIMER
+    tel = {"phase_seconds": {name: round(s["seconds"], 3)
+                             for name, s in TIMER.snapshot().items()}}
+    wm = obs.memory.watermark()
+    if wm:
+        tel["memory"] = wm
+    return tel
+
+
 def main():
     n_rows = int(os.environ.get("LGBM_TPU_BENCH_ROWS", 10_000_000))
     n_iters = int(os.environ.get("LGBM_TPU_BENCH_ITERS", 20))
@@ -96,7 +109,8 @@ def main():
                       f"{n_rows // 1_000_000}m_l{num_leaves}_b{max_bin}",
             "value": round(iters_per_sec, 4), "unit": "iters/sec",
             "vs_baseline": round(iters_per_sec / baseline_here, 4),
-            "bin_s": round(t_bin, 2), "compile_s": round(t_compile, 2)}))
+            "bin_s": round(t_bin, 2), "compile_s": round(t_compile, 2),
+            "telemetry": _telemetry_snapshot()}))
         return
     prob = 1.0 / (1.0 + np.exp(-np.asarray(booster.raw_train_score())))
     auc = float(_auc(jnp.asarray(y), jnp.asarray(prob), None))
@@ -146,6 +160,7 @@ def main():
         "compile_s": round(t_compile, 2),
         "train_auc": round(auc, 4),
         **({"ref_auc": round(ref_auc, 4)} if ref_auc is not None else {}),
+        "telemetry": _telemetry_snapshot(),
     }
     # surface the serving headline recorded by bench_predict.py, so one
     # bench.py line carries both trajectories (train + predict)

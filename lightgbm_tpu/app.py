@@ -20,6 +20,7 @@ from typing import Dict, List
 
 import numpy as np
 
+from . import obs
 from .basic import Booster, Dataset
 from .config import Config, canonical_name
 from .engine import train as engine_train
@@ -191,6 +192,9 @@ def run_predict(conf: Config, params: Dict) -> None:
     fmt = "%d" if conf.predict_leaf_index else "%.18g"
     np.savetxt(conf.output_result, out, fmt=fmt, delimiter="\t")
     log.info(f"Finished prediction; results saved to {conf.output_result}")
+    exported = obs.export_all(conf.metrics_out)
+    if exported:
+        log.info("telemetry exported to %s", exported)
 
 
 def run_refit(conf: Config, params: Dict) -> None:
@@ -241,6 +245,9 @@ def main(argv: List[str]) -> int:
         return 0
     params = parse_args(argv)
     conf = Config(params)
+    # telemetry knobs apply to every task (train re-applies per run; predict/
+    # refit/convert only see this one)
+    obs.configure_from_config(conf)
     task = conf.task
     if task == "train":
         run_train(conf, params)

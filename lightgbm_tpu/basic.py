@@ -25,7 +25,7 @@ import jax
 import jax.numpy as jnp
 
 from .binning import BinMapper, BinnedDataset, bin_data, find_bin_mappers
-from .config import Config, params_to_config
+from .config import Config, canonical_name, params_to_config
 from .metrics import create_metrics, default_metric_for_objective
 from .models.gbdt import GBDT
 from .models.tree import Tree, stack_trees
@@ -737,6 +737,14 @@ class Booster:
                  model_str: Optional[str] = None):
         self.params = dict(params or {})
         self.config = params_to_config(self.params)
+        # surface telemetry knobs passed at the Booster level (predict-only
+        # workflows never go through engine.train); only an EXPLICIT param
+        # reconfigures — a Booster built with defaults must not switch off
+        # telemetry another entry point enabled
+        if any(canonical_name(k) in ("telemetry", "metrics_out")
+               for k in self.params):
+            from . import obs
+            obs.configure_from_config(self.config)
         self._gbdt: Optional[GBDT] = None
         self.trees: List[Tree] = []
         self._loaded_meta: Dict[str, Any] = {}
@@ -948,13 +956,23 @@ class Booster:
         """Cached PredictEngine for the current tree list; invalidated only
         on tree-count change (like the old per-Booster PseudoRouter cache —
         shuffle_models/refit reset it explicitly since they keep the count)."""
+        from . import obs
         from .serving import PredictEngine
         engine = getattr(self, "_predict_engine", None)
         if engine is None or engine.n_trees != len(trees):
+            reason = "new" if engine is None else "invalidated"
             engine = PredictEngine(trees, n_features, k, self._avg_output(),
-                                   objective=self._objective_for_predict())
+                                   objective=self._objective_for_predict(),
+                                   upload_reason=reason)
             self._predict_engine = engine
             self._pseudo_router = engine.router   # kept for introspection
+            if obs.enabled():
+                obs.METRICS.counter("predict_engine_cache",
+                                    "engine cache lookups",
+                                    outcome="miss").inc()
+        elif obs.enabled():
+            obs.METRICS.counter("predict_engine_cache",
+                                "engine cache lookups", outcome="hit").inc()
         return engine
 
     def _avg_output(self) -> bool:

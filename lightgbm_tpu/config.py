@@ -186,6 +186,16 @@ _PARAMS: Dict[str, Tuple[Any, Tuple[str, ...]]] = {
     "network_retries": (3, ()),
     # fault-injection spec (utils/faults.py), e.g. "snapshot_write:2"
     "faults": ("", ("fault_spec",)),
+    # ---- observability (new in this framework; see lightgbm_tpu/obs/) ----
+    # structured telemetry: schema'd events + metrics around the hot paths;
+    # LGBMTPU_TELEMETRY=0/1 env overrides the param in either direction
+    "telemetry": (False, ()),
+    # directory for events.jsonl / metrics.json / metrics.prom exports
+    # (written at end of train/predict when telemetry is on)
+    "metrics_out": ("", ("metrics_dir",)),
+    # start an on-demand XLA profiler capture into this directory for the
+    # duration of training (heavy; leave empty in production)
+    "xla_trace_out": ("", ("xla_trace_dir",)),
 }
 
 _LIST_FLOAT = {"feature_contri", "cegb_penalty_feature_lazy", "cegb_penalty_feature_coupled", "label_gain", "auc_mu_weights"}

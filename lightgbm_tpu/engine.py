@@ -14,9 +14,11 @@ from typing import Any, Callable, Dict, List, Optional, Union
 import numpy as np
 
 from . import callback as cb
+from . import obs
 from . import snapshot as snap
 from .basic import Booster, Dataset
 from .config import Config, params_to_config
+from .obs import tracing
 from .utils import faults, log
 from .utils.timer import TIMER
 
@@ -48,6 +50,11 @@ def train(params: Dict[str, Any], train_set: Dataset,
     """
     params = dict(params or {})
     conf = params_to_config(params)
+    obs.configure_from_config(conf)
+    # fresh timing namespace per run: accumulations must not bleed across
+    # successive train() calls in one process (the previous run's table
+    # stays readable via TIMER.last_run)
+    TIMER.begin_run()
     if conf.faults:
         faults.configure(conf.faults)
     if conf.num_iterations != 100 and num_boost_round == 100:
@@ -86,6 +93,8 @@ def train(params: Dict[str, Any], train_set: Dataset,
                 resumed = True
                 log.info(f"resumed from {payload.model_path} "
                          f"(iteration {payload.iteration})")
+                obs.emit("resume", iteration=int(payload.iteration),
+                         path=payload.model_path, source="snapshot")
             except ValueError as e:
                 log.warning(f"cannot resume from {payload.model_path}: {e}; "
                             "training from scratch")
@@ -140,9 +149,14 @@ def train(params: Dict[str, Any], train_set: Dataset,
     snapshot_dir = snap.snapshot_dir_for(conf)
     nf_eval_warned: set = set()
     finished = False
+    tele = obs.enabled()
+    tracing.maybe_start_xla_trace(conf.xla_trace_out)
     t_start = time.perf_counter()
+    t_iter0 = t_start
     try:
         for i in range(begin_iteration, end_iteration):
+            if tele:
+                t_iter0 = time.perf_counter()
             # fault point for kill-and-resume tests: an armed 'tree_update'
             # fault propagates out of train() like a crash at iteration i
             faults.fault_point("tree_update")
@@ -169,6 +183,24 @@ def train(params: Dict[str, Any], train_set: Dataset,
                                  begin_iteration=begin_iteration,
                                  end_iteration=end_iteration,
                                  evaluation_result_list=evaluation_result_list))
+            if tele:
+                # per-iteration telemetry: wall clock + throughput, plus the
+                # newest lagged leaf-count/best-gain stats (≤8 iterations old
+                # by design — reading them synchronously would stall the
+                # async dispatch pipeline)
+                dt = time.perf_counter() - t_iter0
+                fields = {"iteration": i + 1, "duration_s": dt,
+                          "rows_per_s": (train_set.num_data / dt)
+                          if dt > 0 else 0.0}
+                lag = booster._gbdt.obs_lagged_stats()
+                if lag:
+                    fields.update(lag)
+                obs.emit("train_iter", **fields)
+                obs.METRICS.counter("train_iterations",
+                                    "boosting iterations completed").inc()
+                obs.METRICS.histogram("train_iter_seconds",
+                                      "iteration wall time").observe(dt)
+                obs.memory.update_gauges(obs.METRICS)
             # per-iteration wall clock (reference: gbdt.cpp:289 "%f seconds
             # elapsed, finished iteration %d" at every metric output interval)
             if conf.verbosity >= 1 and conf.metric_freq > 0 \
@@ -204,6 +236,9 @@ def train(params: Dict[str, Any], train_set: Dataset,
         booster.best_iteration = e.best_iteration + 1
         for item in (e.best_score or []):
             booster.best_score.setdefault(item[0], {})[item[1]] = item[2]
+    finally:
+        # the capture brackets the boosting loop and survives fatal exits
+        tracing.stop_xla_trace()
     # drop trailing phantom stumps queued by the lagged finished-check
     # (reference stops without adding them, gbdt.cpp:430)
     booster._gbdt.finish_training()
@@ -211,6 +246,13 @@ def train(params: Dict[str, Any], train_set: Dataset,
         booster._ensure_host_trees()
     if conf.verbosity >= 2:
         log.debug(TIMER.summary_string())
+    if tele:
+        for name, rec in TIMER.snapshot().items():
+            obs.METRICS.gauge("phase_seconds", "TIMER phase wall time",
+                              phase=name).set(rec["seconds"])
+        out = obs.export_all(conf.metrics_out)
+        if out:
+            log.info("telemetry exported to %s", out)
     return booster
 
 

@@ -866,6 +866,7 @@ class GBDT:
             hess if custom else dummy,
             jnp.float32(shrink), jnp.int32(self.iter_),
             jnp.float32(self.iter_ + 1), cegb_in)
+        self._obs_track_compiles(key, fn)
         k = self.num_tree_per_iteration
         if k > 8:
             # scan path returns class-stacked TreeArrays; unstack in ONE
@@ -881,6 +882,61 @@ class GBDT:
                 unst = self._unstack_fn = jax.jit(_unstack)
             trees = list(unst(stacked, lids))
         return trees, new_score, cegb_out, ok
+
+    def _obs_track_compiles(self, key: str, fn) -> None:
+        """Compile/retrace telemetry: poll the jitted step's executable-cache
+        size after dispatch — growth means trace+lower+compile happened (the
+        first call is the initial compile, any later growth is a retrace).
+        Pure host-side observation of an already-built jit wrapper; asserting
+        this counter stays flat is how tests prove telemetry adds no device
+        code."""
+        from .. import obs
+        if not obs.enabled():
+            return
+        try:
+            cs = int(fn._cache_size())
+        except Exception:
+            return
+        seen = getattr(self, "_obs_cache_sizes", None)
+        if seen is None:
+            seen = self._obs_cache_sizes = {}
+        prev = seen.get(key, 0)
+        if cs > prev:
+            seen[key] = cs
+            obs.emit("compile", what="fused_step", key=key, cache_size=cs)
+            obs.METRICS.counter("jit_compiles",
+                                "programs traced+lowered", fn=key).inc(cs - prev)
+            if prev > 0:
+                obs.METRICS.counter("jit_retraces",
+                                    "cache growth after the first compile",
+                                    fn=key).inc(cs - prev)
+
+    def _obs_note_lagged(self, it_no: int, cnts) -> None:
+        """Consume one aged-out queue entry into the latest lagged per-tree
+        stats (engine.train attaches them to train_iter events). Leaf counts
+        were just host-read by the finished check; gains were async-copied
+        ≥8 iterations ago, so np.asarray here never blocks the pipeline."""
+        gq = getattr(self, "_obs_gains", None)
+        gains = gq.pop(it_no, None) if gq else None
+        from .. import obs
+        if not obs.enabled():
+            return
+        try:
+            best = 0.0
+            for i, c in enumerate(cnts):
+                nsplit = int(c) - 1
+                if gains is not None and nsplit > 0:
+                    best = max(best, float(np.max(np.asarray(gains[i])[:nsplit])))
+            self._obs_lagged = {"lagged_iteration": int(it_no),
+                                "leaf_count": int(sum(int(c) for c in cnts)),
+                                "best_gain": best}
+        except Exception:   # telemetry must never break training
+            pass
+
+    def obs_lagged_stats(self) -> Optional[Dict]:
+        """Latest {lagged_iteration, leaf_count, best_gain} from the lagged
+        finished-check queue (lags ≤8 iterations behind by design)."""
+        return getattr(self, "_obs_lagged", None)
 
     def _grow_fn(self):
         if self.config.grow_policy == "depthwise":
@@ -902,6 +958,10 @@ class GBDT:
                 log.warning(f"non-finite scores at iteration {self.iter_}; "
                             "discarding this iteration's tree(s) "
                             "(nonfinite_policy=warn_skip_tree)")
+                from .. import obs
+                obs.emit("nonfinite_guard", where="train_score",
+                         policy=self._nf_policy, iteration=int(self.iter_),
+                         action="skip_tree")
                 return False
             if self._cegb_dev is not None:
                 self._cegb_dev = cegb_out
@@ -944,10 +1004,26 @@ class GBDT:
                 ok.copy_to_host_async()
             except Exception:
                 pass
+            from .. import obs
+            if obs.enabled():
+                # per-iteration split gains for telemetry ride the SAME lag
+                # discipline: async D2H copies now, host max at pop ≥8 iters
+                # later — a pure transfer, no new XLA program, no sync
+                gains = [t.split_gain for t, _ in trees]
+                for g in gains:
+                    try:
+                        g.copy_to_host_async()
+                    except Exception:
+                        pass
+                gq = getattr(self, "_obs_gains", None)
+                if gq is None:
+                    gq = self._obs_gains = {}
+                gq[self.iter_] = gains
             q.append((self.iter_, cnts, ok))
             if len(q) > 8:
                 it_old, old, okf = q.pop(0)
                 self._check_nf_flag(it_old, okf)
+                self._obs_note_lagged(it_old, old)
                 if all(int(x) <= 1 for x in old):
                     self._pop_trailing_stumps()
                     return True
@@ -993,6 +1069,9 @@ class GBDT:
                 self._pop_trailing_stumps()
         if q is not None:
             q.clear()
+        gq = getattr(self, "_obs_gains", None)
+        if gq is not None:
+            gq.clear()
 
     def _check_nf_flag(self, it_no: int, okf) -> None:
         """Consume one lag-queued finite flag (fatal raises, clip warns once;
@@ -1000,6 +1079,9 @@ class GBDT:
         the flag is only forced once its device copy is long finished)."""
         if okf is None or bool(okf):
             return
+        from .. import obs
+        obs.emit("nonfinite_guard", where="train_score",
+                 policy=self._nf_policy, iteration=int(it_no))
         if self._nf_policy != "fatal":
             if not self._nf_warned:
                 self._nf_warned = True
@@ -1166,6 +1248,9 @@ class GBDT:
         q = getattr(self, "_pending_leafcounts_q", None)
         if q:
             q.clear()
+        gq = getattr(self, "_obs_gains", None)
+        if gq is not None:
+            gq.clear()
         self.models_host = []  # invalidate host cache; rebuilt on demand
         k = self.num_tree_per_iteration
         for cls in reversed(range(k)):
@@ -1262,6 +1347,9 @@ class GBDT:
         finite = bool(np.isfinite(grad).all() and np.isfinite(hess).all())
         if finite:
             return grad, hess, False
+        from .. import obs
+        obs.emit("nonfinite_guard", where="custom_gradients",
+                 policy=self._nf_policy, iteration=int(self.iter_))
         if self._nf_policy == "clip":
             if not self._nf_warned:
                 self._nf_warned = True
@@ -1413,6 +1501,9 @@ class GBDT:
         q = getattr(self, "_pending_leafcounts_q", None)
         if q is not None:
             q.clear()
+        gq = getattr(self, "_obs_gains", None)
+        if gq is not None:
+            gq.clear()
         self._apply_extra_resume_state(arrays, meta)
 
     def _extra_resume_state(self, arrays: Dict[str, np.ndarray],

@@ -1,0 +1,118 @@
+"""Observability subsystem: telemetry events, metrics, trace spans, memory.
+
+Off by default and designed so the disabled fast path is one attribute read
+(`obs.enabled()` / the `_STATE.enabled` check at the top of `emit`) — the
+training loop and the PredictEngine call into here on every iteration /
+batch, and the <2% overhead budget only holds if "off" costs nothing.
+
+Enable with the ``telemetry=1`` config param or the ``LGBMTPU_TELEMETRY=1``
+environment variable (env wins, so an operator can switch telemetry on for
+one run without touching params).  ``metrics_out=<dir>`` names a directory
+that :func:`export_all` fills with three crash-safe files::
+
+    events.jsonl    one JSON object per event (schema: obs/events.py)
+    metrics.json    nested metric snapshot
+    metrics.prom    Prometheus textfile exposition format
+
+Everything is host-side bookkeeping around the existing jitted programs:
+enabling telemetry changes **zero device code** — no new jit boundaries, no
+new retraces (tests/test_observability.py asserts this with the same lowering
+counters the serving tests use).
+"""
+from __future__ import annotations
+
+import os
+import threading
+from typing import Any, Dict, List, Optional
+
+from ..utils import log
+from . import memory, tracing
+from .events import EVENT_SCHEMAS, EventLog, register_event
+from .metrics import Counter, Gauge, Histogram, MetricsRegistry
+from .tracing import maybe_start_xla_trace, span, stop_xla_trace
+
+EVENTS = EventLog()
+METRICS = MetricsRegistry()
+
+
+def _env_enabled() -> Optional[bool]:
+    v = os.environ.get("LGBMTPU_TELEMETRY")
+    if v is None or v == "":
+        return None
+    return v.strip().lower() not in ("0", "false", "no", "off")
+
+
+class _State:
+    def __init__(self) -> None:
+        # env-only workflows (LGBMTPU_TELEMETRY=1 + predict without any
+        # configure call) start enabled; configure_from_config re-reads the
+        # env anyway, so this is just the pre-configure default
+        self.enabled = bool(_env_enabled())
+        self.metrics_out = ""
+        self.lock = threading.Lock()
+
+
+_STATE = _State()
+
+
+def enabled() -> bool:
+    return _STATE.enabled
+
+
+def configure(enabled: Optional[bool] = None,
+              metrics_out: Optional[str] = None) -> None:
+    with _STATE.lock:
+        if enabled is not None:
+            _STATE.enabled = bool(enabled)
+        if metrics_out is not None:
+            _STATE.metrics_out = str(metrics_out)
+
+
+def configure_from_config(conf) -> None:
+    """Apply a Config's telemetry knobs (engine.train / CLI entry).
+    ``LGBMTPU_TELEMETRY`` overrides the param in either direction."""
+    env = _env_enabled()
+    on = bool(getattr(conf, "telemetry", False)) if env is None else env
+    configure(enabled=on, metrics_out=getattr(conf, "metrics_out", ""))
+
+
+def emit(etype: str, **fields: Any) -> None:
+    """Record one telemetry event (no-op unless telemetry is enabled).
+    Event types and fields must be registered in ``obs.events`` — an
+    unregistered type or field raises (see scripts/check_telemetry_schema.py
+    for the static check over call sites)."""
+    if not _STATE.enabled:
+        return
+    EVENTS.emit(etype, **fields)
+
+
+def reset() -> None:
+    """Clear accumulated events and metrics (per-run isolation in tests)."""
+    EVENTS.clear()
+    METRICS.clear()
+
+
+def export_all(out_dir: Optional[str] = None) -> Optional[str]:
+    """Write events.jsonl + metrics.json + metrics.prom into ``out_dir``
+    (default: the configured ``metrics_out``). Returns the directory written,
+    or None when no directory is configured or telemetry is off."""
+    out_dir = out_dir if out_dir is not None else _STATE.metrics_out
+    if not out_dir or not _STATE.enabled:
+        return None
+    try:
+        memory.update_gauges(METRICS)
+        EVENTS.write_jsonl(os.path.join(out_dir, "events.jsonl"))
+        METRICS.write_json(os.path.join(out_dir, "metrics.json"))
+        METRICS.write_prometheus(os.path.join(out_dir, "metrics.prom"))
+    except OSError as e:
+        log.warning(f"telemetry export to {out_dir!r} failed "
+                    f"({type(e).__name__}: {e})")
+        return None
+    return out_dir
+
+
+__all__ = ["EVENTS", "METRICS", "EVENT_SCHEMAS", "EventLog", "MetricsRegistry",
+           "Counter", "Gauge", "Histogram", "register_event",
+           "configure", "configure_from_config", "enabled", "emit", "reset",
+           "export_all", "span", "maybe_start_xla_trace", "stop_xla_trace",
+           "memory", "tracing"]

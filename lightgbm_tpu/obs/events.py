@@ -1,0 +1,145 @@
+"""Structured telemetry events: a bounded in-memory log of typed records.
+
+The reference has no event telemetry at all — its only observability surface
+is the ``USE_TIMER`` wall-clock table (common.h:1032) and free-form stderr
+logging.  Here every interesting lifecycle moment (a boosting iteration, an
+XLA compile, a snapshot write, a resume, a non-finite guard trip, a predict
+batch, a serving-table upload, an injected fault, a distributed retry, a
+consistency fence) becomes a *schema-registered* event: the type must be
+registered in :data:`EVENT_SCHEMAS`, required fields must be present, and no
+unregistered field may appear.  Violations raise immediately — call sites are
+all internal, and ``scripts/check_telemetry_schema.py`` additionally verifies
+them statically, so a schema error is a bug, not an operational condition.
+
+Events are held in a bounded deque (oldest dropped first; the drop count is
+itself observable) and serialized as JSON Lines through
+``utils.atomic_io.atomic_write_text`` so a crash mid-export never leaves a
+truncated file.
+"""
+from __future__ import annotations
+
+import collections
+import json
+import threading
+import time
+from typing import Any, Dict, List, Optional, Tuple
+
+from ..utils import atomic_io
+
+# type name -> (required fields, optional fields); each field maps to the
+# expected python type. int is accepted where float is declared; bool is NOT
+# accepted for int/float (it is a distinct wire type in the JSONL output).
+_NUM = (int, float)
+EVENT_SCHEMAS: Dict[str, Tuple[Dict[str, Any], Dict[str, Any]]] = {
+    # one boosting iteration finished (engine.train loop). leaf_count /
+    # best_gain come from the lagged async finished-check queue and therefore
+    # describe iteration ``lagged_iteration`` (<= iteration), never the
+    # current one — reading them synchronously would stall the device pipeline.
+    "train_iter": ({"iteration": int, "duration_s": _NUM, "rows_per_s": _NUM},
+                   {"leaf_count": int, "best_gain": _NUM,
+                    "lagged_iteration": int}),
+    # a jitted program was built (host-side tracing/lowering observed via
+    # the function's cache size; device code itself is unchanged)
+    "compile": ({"what": str, "cache_size": int},
+                {"duration_s": _NUM, "key": str}),
+    "snapshot_write": ({"iteration": int, "path": str, "duration_s": _NUM},
+                       {"kept": int}),
+    "resume": ({"iteration": int, "path": str}, {"source": str}),
+    # a non-finite guard fired (gradients/scores/eval values)
+    "nonfinite_guard": ({"where": str, "policy": str},
+                        {"iteration": int, "action": str}),
+    "predict_batch": ({"rows": int, "bucket": int, "duration_s": _NUM},
+                      {"chunked": bool, "chunks": int, "engine_calls": int}),
+    # PredictEngine uploaded tree tables to device (new engine or model
+    # version change invalidated the cached one)
+    "engine_upload": ({"n_trees": int, "num_class": int},
+                      {"reason": str, "duration_s": _NUM}),
+    "fault_injected": ({"point": str}, {"hit": int}),
+    "dist_retry": ({"name": str, "attempt": int},
+                   {"error": str, "delay_s": _NUM}),
+    "consistency_fence": ({"processes": int, "ok": bool},
+                          {"mismatched_fields": int}),
+}
+
+
+def register_event(name: str, required: Dict[str, Any],
+                   optional: Optional[Dict[str, Any]] = None) -> None:
+    """Register an event type (extension point for out-of-tree consumers)."""
+    if name in EVENT_SCHEMAS:
+        raise ValueError(f"event type {name!r} already registered")
+    EVENT_SCHEMAS[name] = (dict(required), dict(optional or {}))
+
+
+def _validate(etype: str, fields: Dict[str, Any]) -> None:
+    schema = EVENT_SCHEMAS.get(etype)
+    if schema is None:
+        raise ValueError(f"unregistered event type {etype!r} "
+                         f"(known: {sorted(EVENT_SCHEMAS)})")
+    required, optional = schema
+    for name, typ in required.items():
+        if name not in fields:
+            raise ValueError(f"event {etype!r} missing required field {name!r}")
+    for name, value in fields.items():
+        typ = required.get(name, optional.get(name))
+        if typ is None:
+            raise ValueError(f"event {etype!r} has unregistered field {name!r}")
+        if typ in (int, _NUM) and isinstance(value, bool):
+            raise ValueError(f"event {etype!r} field {name!r}: got bool where "
+                             f"{'number' if typ is _NUM else 'int'} expected")
+        if not isinstance(value, typ):
+            want = "number" if typ is _NUM else typ.__name__
+            raise ValueError(f"event {etype!r} field {name!r}: expected {want},"
+                             f" got {type(value).__name__} ({value!r})")
+
+
+class EventLog:
+    """Bounded, thread-safe event buffer.
+
+    ``emit`` is the single write path; it validates against the schema
+    registry, stamps a wall-clock ``ts``, and appends.  When the buffer is
+    full the oldest event is dropped and ``dropped`` increments — a bounded
+    log can never grow a long training run out of host memory.
+    """
+
+    def __init__(self, capacity: int = 65536) -> None:
+        self._events: collections.deque = collections.deque(maxlen=capacity)
+        self._lock = threading.Lock()
+        self.dropped = 0
+
+    def emit(self, etype: str, **fields: Any) -> None:
+        _validate(etype, fields)
+        rec = {"ts": time.time(), "type": etype}
+        rec.update(fields)
+        with self._lock:
+            if len(self._events) == self._events.maxlen:
+                self.dropped += 1
+            self._events.append(rec)
+
+    def __len__(self) -> int:
+        with self._lock:
+            return len(self._events)
+
+    def snapshot(self) -> List[Dict[str, Any]]:
+        with self._lock:
+            return list(self._events)
+
+    def clear(self) -> None:
+        with self._lock:
+            self._events.clear()
+            self.dropped = 0
+
+    def to_jsonl(self) -> str:
+        lines = [json.dumps(rec, sort_keys=True, default=_json_default)
+                 for rec in self.snapshot()]
+        return "\n".join(lines) + ("\n" if lines else "")
+
+    def write_jsonl(self, path: str) -> None:
+        atomic_io.atomic_write_text(path, self.to_jsonl())
+
+
+def _json_default(obj: Any) -> Any:
+    # numpy scalars sneak in from host reads of device arrays
+    item = getattr(obj, "item", None)
+    if callable(item):
+        return item()
+    return str(obj)

@@ -1,0 +1,73 @@
+"""Device-memory watermark sampling.
+
+``jax.local_devices()[i].memory_stats()`` exposes allocator stats on TPU/GPU
+backends (``bytes_in_use``, ``peak_bytes_in_use``, ``bytes_limit``); the CPU
+backend returns **None**, so every consumer here is None-safe and the whole
+module degrades to empty samples on hosts without device stats — telemetry
+must never make a CPU test run fail.
+
+:func:`sample` takes one reading; :func:`update_gauges` folds it into
+``device_memory_bytes{device=...,stat=...}`` gauges (peak kept as a
+high-watermark across calls); :func:`watermark` summarizes the highest peak
+across devices for bench output.
+"""
+from __future__ import annotations
+
+from typing import Any, Dict, List, Optional
+
+_STATS = ("bytes_in_use", "peak_bytes_in_use", "bytes_limit")
+
+
+def sample() -> List[Dict[str, Any]]:
+    """One reading per local device that reports memory stats."""
+    try:
+        import jax
+        devices = jax.local_devices()
+    except Exception:
+        return []
+    out: List[Dict[str, Any]] = []
+    for d in devices:
+        try:
+            stats = d.memory_stats()
+        except Exception:
+            stats = None
+        if not stats:   # CPU backend: memory_stats() is None
+            continue
+        rec: Dict[str, Any] = {"device": str(d.id),
+                               "platform": getattr(d, "platform", "?")}
+        for k in _STATS:
+            if k in stats:
+                rec[k] = int(stats[k])
+        out.append(rec)
+    return out
+
+
+def update_gauges(registry) -> List[Dict[str, Any]]:
+    """Fold one sample into gauges on ``registry``; returns the raw sample.
+    ``bytes_in_use`` is point-in-time (set); peaks are high-watermarked
+    (set_max) so periodic sampling converges on the true run maximum."""
+    readings = sample()
+    for rec in readings:
+        dev = rec["device"]
+        for k in _STATS:
+            if k not in rec:
+                continue
+            g = registry.gauge("device_memory_bytes",
+                               "device allocator stats", device=dev, stat=k)
+            if k == "peak_bytes_in_use":
+                g.set_max(rec[k])
+            else:
+                g.set(rec[k])
+    return readings
+
+
+def watermark(readings: Optional[List[Dict[str, Any]]] = None) -> Dict[str, Any]:
+    """Highest peak across devices (bench.py attaches this to BENCH json).
+    Returns {} when no device reports stats (CPU backend)."""
+    readings = sample() if readings is None else readings
+    peaks = [r["peak_bytes_in_use"] for r in readings
+             if "peak_bytes_in_use" in r]
+    if not peaks:
+        return {}
+    return {"peak_bytes_in_use_max": max(peaks),
+            "devices_reporting": len(peaks)}

@@ -1,0 +1,72 @@
+"""Trace spans: one name, three sinks.
+
+A :func:`span` scope feeds the same name to (1) the ``TIMER`` wall-clock
+registry (whose scopes already emit ``jax.profiler.TraceAnnotation`` ranges,
+so the name lines up in XLA profiler timelines), and (2) — when telemetry is
+enabled — a log2 latency histogram ``span_seconds{span=<name>}`` in the
+metrics registry.  Code that already sits inside a ``TIMER.scope`` keeps
+working unchanged; new call sites should prefer ``span``.
+
+:func:`maybe_start_xla_trace` / :func:`stop_xla_trace` drive an on-demand XLA
+profiler capture (``jax.profiler.start_trace``) gated by the ``xla_trace_out``
+config knob — a full device trace is far too heavy to leave on, so it only
+runs when an operator names an output directory.
+"""
+from __future__ import annotations
+
+import contextlib
+import time
+from typing import Optional
+
+from ..utils import log
+from ..utils.timer import TIMER
+
+_xla_trace_dir: Optional[str] = None
+
+
+@contextlib.contextmanager
+def span(name: str, block_on=None):
+    """Timed scope: TIMER accumulation + TraceAnnotation + latency histogram
+    (histogram only when telemetry is on; the disabled path adds only a clock
+    read over a bare ``TIMER.scope``)."""
+    from . import enabled, METRICS
+    t0 = time.perf_counter()
+    with TIMER.scope(name, block_on=block_on):
+        yield
+    if enabled():
+        METRICS.histogram("span_seconds", "span wall time by name",
+                          span=name).observe(time.perf_counter() - t0)
+
+
+def maybe_start_xla_trace(out_dir: str) -> bool:
+    """Start an XLA profiler capture into ``out_dir`` (no-op on empty dir or
+    if a capture is already running). Returns whether a trace was started."""
+    global _xla_trace_dir
+    if not out_dir or _xla_trace_dir is not None:
+        return False
+    try:
+        import jax
+        jax.profiler.start_trace(out_dir)
+    except Exception as e:   # profiler backends vary; never break training
+        log.warning(f"could not start XLA trace into {out_dir!r} "
+                    f"({type(e).__name__}: {e})")
+        return False
+    _xla_trace_dir = out_dir
+    log.info("XLA profiler trace started (xla_trace_out=%s)", out_dir)
+    return True
+
+
+def stop_xla_trace() -> Optional[str]:
+    """Stop the running capture (if any); returns its output dir."""
+    global _xla_trace_dir
+    if _xla_trace_dir is None:
+        return None
+    out, _xla_trace_dir = _xla_trace_dir, None
+    try:
+        import jax
+        jax.profiler.stop_trace()
+    except Exception as e:  # pragma: no cover - symmetric guard
+        log.warning(f"could not stop XLA trace ({type(e).__name__}: {e})")
+        return None
+    log.info("XLA profiler trace written to %s", out)
+    return out

@@ -100,6 +100,9 @@ def consistency_fence(config, train_set=None, raise_on_mismatch: bool = True
     mismatched = [i for i in range(len(items))
                   if not (gathered[:, i] == gathered[0, i]).all()]
     nproc = gathered.shape[0]
+    from .. import obs
+    obs.emit("consistency_fence", processes=int(nproc), ok=not mismatched,
+             mismatched_fields=len(mismatched))
     if not mismatched:
         log.info(f"consistency fence passed across {nproc} processes "
                  f"({len(items)} fields verified)")

@@ -32,12 +32,14 @@ from __future__ import annotations
 import os
 import queue
 import threading
+import time
 from typing import Dict, List, Optional
 
 import jax
 import jax.numpy as jnp
 import numpy as np
 
+from . import obs
 from .io.pseudo_bins import PseudoRouter
 from .ops import predict as P
 
@@ -70,7 +72,8 @@ class PredictEngine:
 
     def __init__(self, trees, n_features: int, k: int, avg_output: bool,
                  objective=None, chunk_rows: Optional[int] = None,
-                 min_bucket: int = _MIN_BUCKET):
+                 min_bucket: int = _MIN_BUCKET, upload_reason: str = "new"):
+        t0 = time.perf_counter()
         self.router = PseudoRouter(trees, n_features)
         self.n_trees = len(trees)
         self.k = max(int(k), 1)
@@ -94,9 +97,19 @@ class PredictEngine:
             self._class_dense = None
         self._class_walk: Optional[List[Dict[str, jax.Array]]] = None
         self._full_stack: Optional[Dict[str, jax.Array]] = None
-        # observability: bucket/chunk traffic for tests and the bench
+        # observability: bucket/chunk traffic for tests and the bench; the
+        # lock guards these host counters when predict is driven from
+        # multiple threads (the device side is thread-safe via jax dispatch)
         self.stats = {"calls": 0, "chunked_calls": 0, "chunks": 0,
                       "buckets_seen": set()}
+        self._stats_lock = threading.Lock()
+        obs.emit("engine_upload", n_trees=int(self.n_trees),
+                 num_class=int(self.k), reason=upload_reason,
+                 duration_s=time.perf_counter() - t0)
+        if obs.enabled():
+            obs.METRICS.counter("engine_uploads",
+                                "PredictEngine table uploads",
+                                reason=upload_reason).inc()
 
     # ---- one-time uploads (lazy for the walk variants) ----
 
@@ -150,7 +163,8 @@ class PredictEngine:
     def _run_bins(self, bins: np.ndarray, n: int, raw_score: bool,
                   pred_leaf: bool) -> np.ndarray:
         b = bucket_rows(n, self.min_bucket, self.chunk_rows)
-        self.stats["buckets_seen"].add(b)
+        with self._stats_lock:
+            self.stats["buckets_seen"].add(b)
         if bins.shape[0] != b:
             bins = np.pad(bins, ((0, b - bins.shape[0]), (0, 0)))
         pbins = jax.device_put(bins)
@@ -188,7 +202,8 @@ class PredictEngine:
             if item is None:
                 break
             bins, m = item
-            self.stats["chunks"] += 1
+            with self._stats_lock:
+                self.stats["chunks"] += 1
             pbins = jax.device_put(bins)
             if pred_leaf:
                 out = np.asarray(P.leaf_bins_ensemble(
@@ -204,13 +219,37 @@ class PredictEngine:
                 pred_leaf: bool = False) -> np.ndarray:
         """Predict on host features [N, F] (already numpy-2d, width-checked
         by the caller). Returns [N] / [N, k] scores or [N, T] leaf ids."""
-        self.stats["calls"] += 1
         n = x.shape[0]
-        if n > self.chunk_rows:
-            self.stats["chunked_calls"] += 1
-            return self._predict_chunked(x, raw_score, pred_leaf)
-        bins = self.router.bin_matrix(np.asarray(x, dtype=np.float64))
-        return self._run_bins(bins, n, raw_score, pred_leaf)
+        tele = obs.enabled()
+        t0 = time.perf_counter() if tele else 0.0
+        chunks_before = self.stats["chunks"]
+        with self._stats_lock:
+            self.stats["calls"] += 1
+        chunked = n > self.chunk_rows
+        if chunked:
+            with self._stats_lock:
+                self.stats["chunked_calls"] += 1
+            out = self._predict_chunked(x, raw_score, pred_leaf)
+        else:
+            bins = self.router.bin_matrix(np.asarray(x, dtype=np.float64))
+            out = self._run_bins(bins, n, raw_score, pred_leaf)
+        if tele:
+            # per-bucket latency histograms: chunked batches attribute to the
+            # chunk-sized bucket, since that is the executable they ran
+            dt = time.perf_counter() - t0
+            b = self.chunk_rows if chunked \
+                else bucket_rows(n, self.min_bucket, self.chunk_rows)
+            obs.METRICS.histogram("predict_latency_seconds",
+                                  "predict wall time by row bucket",
+                                  bucket=str(b)).observe(dt)
+            obs.METRICS.counter("predict_calls", "predict() calls").inc()
+            obs.METRICS.counter("predict_rows", "rows scored").inc(n)
+            fields = {"rows": int(n), "bucket": int(b), "duration_s": dt,
+                      "chunked": chunked}
+            if chunked:
+                fields["chunks"] = int(self.stats["chunks"] - chunks_before)
+            obs.emit("predict_batch", **fields)
+        return out
 
     def warmup(self, sizes=(1,), n_features: Optional[int] = None,
                pred_leaf: bool = False) -> None:

@@ -7,6 +7,12 @@ wall-clock scopes, printed as a sorted table. TPU addition: scopes also emit
 profiler traces, and a scope can optionally block on device results so
 asynchronous dispatch doesn't attribute device time to the wrong scope.
 
+The registry is thread-safe (the PredictEngine drives scopes from its chunk
+producer thread and from concurrent callers) and namespaced per training run:
+``engine.train`` calls :meth:`TimerRegistry.begin_run` so accumulations don't
+bleed across successive ``train()`` calls in one process — the previous run's
+table stays readable via ``last_run``.
+
 Usage::
 
     from lightgbm_tpu.utils.timer import TIMER, timed
@@ -21,8 +27,10 @@ Usage::
 from __future__ import annotations
 
 import contextlib
+import functools
+import threading
 import time
-from typing import Dict, Optional
+from typing import Dict, Optional, Tuple
 
 import jax
 
@@ -31,11 +39,23 @@ class TimerRegistry:
     def __init__(self) -> None:
         self._acc: Dict[str, float] = {}
         self._cnt: Dict[str, int] = {}
+        self._lock = threading.Lock()
+        self.last_run: Dict[str, Tuple[float, int]] = {}
         self.enabled = True
 
     def reset(self) -> None:
-        self._acc.clear()
-        self._cnt.clear()
+        with self._lock:
+            self._acc.clear()
+            self._cnt.clear()
+
+    def begin_run(self) -> None:
+        """Start a fresh accumulation namespace (one per train() call):
+        archives the current table into ``last_run`` and clears."""
+        with self._lock:
+            self.last_run = {k: (self._acc[k], self._cnt.get(k, 0))
+                             for k in self._acc}
+            self._acc.clear()
+            self._cnt.clear()
 
     @contextlib.contextmanager
     def scope(self, name: str, block_on=None):
@@ -50,27 +70,37 @@ class TimerRegistry:
             yield
             if block_on is not None:
                 jax.block_until_ready(block_on() if callable(block_on) else block_on)
-        dt = time.perf_counter() - t0
-        self._acc[name] = self._acc.get(name, 0.0) + dt
-        self._cnt[name] = self._cnt.get(name, 0) + 1
+        self.add(name, time.perf_counter() - t0)
 
     def add(self, name: str, seconds: float) -> None:
-        self._acc[name] = self._acc.get(name, 0.0) + seconds
-        self._cnt[name] = self._cnt.get(name, 0) + 1
+        with self._lock:
+            self._acc[name] = self._acc.get(name, 0.0) + seconds
+            self._cnt[name] = self._cnt.get(name, 0) + 1
 
     def get(self, name: str) -> float:
-        return self._acc.get(name, 0.0)
+        with self._lock:
+            return self._acc.get(name, 0.0)
+
+    def snapshot(self) -> Dict[str, Dict[str, float]]:
+        """{name: {"seconds", "count"}} — bench.py attaches this to its
+        telemetry block; obs.export_all folds it into metrics.json."""
+        with self._lock:
+            return {k: {"seconds": self._acc[k], "count": self._cnt.get(k, 0)}
+                    for k in self._acc}
 
     def summary_string(self) -> str:
         """Sorted table (reference prints the same at program exit,
         common.h:1056 Timer::~Timer)."""
-        if not self._acc:
+        with self._lock:
+            acc = dict(self._acc)
+            cnt = dict(self._cnt)
+        if not acc:
             return "No timing scopes recorded"
         lines = ["LightGBM-TPU timing summary:"]
-        width = max(len(k) for k in self._acc)
-        for name, sec in sorted(self._acc.items(), key=lambda kv: -kv[1]):
+        width = max(len(k) for k in acc)
+        for name, sec in sorted(acc.items(), key=lambda kv: -kv[1]):
             lines.append(f"  {name:<{width}s} {sec:10.3f} s  "
-                         f"(x{self._cnt[name]})")
+                         f"(x{cnt[name]})")
         return "\n".join(lines)
 
 
@@ -80,14 +110,13 @@ TIMER = TimerRegistry()
 def timed(name: str, block: bool = False):
     """Decorator form (reference: FunctionTimer, common.h:1076)."""
     def wrap(fn):
+        @functools.wraps(fn)
         def inner(*args, **kwargs):
             with TIMER.scope(name):
                 out = fn(*args, **kwargs)
                 if block:
                     jax.block_until_ready(out)
             return out
-        inner.__name__ = getattr(fn, "__name__", name)
-        inner.__doc__ = fn.__doc__
         return inner
     return wrap
 

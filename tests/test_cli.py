@@ -9,8 +9,13 @@ from lightgbm_tpu.app import main, parse_args
 from lightgbm_tpu.io.parser import detect_format, load_file
 
 REF = "/root/reference/examples"
+# the reference checkout is an environment amenity, not a requirement: skip
+# (don't error) the comparison tests on machines without it
+needs_ref = pytest.mark.skipif(not os.path.isdir(REF),
+                               reason=f"{REF} not available")
 
 
+@needs_ref
 def test_detect_format_tsv():
     kind, delim = detect_format(f"{REF}/binary_classification/binary.train")
     assert kind == "tsv" and delim == "\t"
@@ -30,6 +35,7 @@ def test_detect_format_csv(tmp_path):
     assert kind == "csv" and delim == ","
 
 
+@needs_ref
 def test_load_tsv_with_weight_sidecar():
     pf = load_file(f"{REF}/binary_classification/binary.train")
     assert pf.X.shape == (7000, 28)
@@ -38,12 +44,14 @@ def test_load_tsv_with_weight_sidecar():
     assert pf.weight is not None and pf.weight.shape == (7000,)
 
 
+@needs_ref
 def test_load_query_sidecar():
     pf = load_file(f"{REF}/lambdarank/rank.train")
     assert pf.group is not None
     assert pf.group.sum() == pf.X.shape[0]
 
 
+@needs_ref
 def test_load_libsvm():
     pf = load_file(f"{REF}/lambdarank/rank.train")
     assert pf.X.shape[0] == 3005
@@ -78,6 +86,7 @@ def test_parse_args_config_file_and_overrides(tmp_path):
     assert out["objective"] == "binary"
 
 
+@needs_ref
 def test_cli_train_predict_convert(tmp_path):
     d = f"{REF}/binary_classification"
     model = tmp_path / "model.txt"
@@ -97,6 +106,7 @@ def test_cli_train_predict_convert(tmp_path):
     assert cpp.exists() and cpp.stat().st_size > 1000
 
 
+@needs_ref
 def test_cli_train_runs_reference_example_config(tmp_path):
     """The reference's examples/binary_classification/train.conf must run
     as-is (VERDICT r1 missing #4), with data paths resolved and the round

@@ -1,4 +1,6 @@
 """sklearn-wrapper and cv() coverage (VERDICT r1 weak #4: zero tests existed)."""
+import os
+
 import numpy as np
 import pytest
 
@@ -194,6 +196,8 @@ def test_cv_lambdarank_group_folds():
     """cv() on a ranking objective splits by WHOLE queries (reference:
     _make_n_folds group handling, engine.py:299) and reports NDCG — VERDICT
     r3 missing #5. Uses the reference's lambdarank example data."""
+    if not os.path.isdir('/root/reference/examples/lambdarank'):
+        pytest.skip('/root/reference not available')
     from lightgbm_tpu.io.parser import load_file
     pf = load_file('/root/reference/examples/lambdarank/rank.train')
     qr = np.loadtxt('/root/reference/examples/lambdarank/rank.train.query'
