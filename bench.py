@@ -51,7 +51,36 @@ def _telemetry_snapshot():
     return tel
 
 
+def _lint_preflight():
+    """Fail fast on tpu-lint violations before burning minutes of TPU time.
+
+    Runs as a subprocess with LGBMTPU_LINT_ONLY=1 so the analyzer stays a
+    pure-AST pass (no second jax init in the child; ~2 s). Skippable for
+    quick iteration with LGBM_TPU_BENCH_SKIP_LINT=1."""
+    if os.environ.get("LGBM_TPU_BENCH_SKIP_LINT"):
+        return
+    import subprocess
+    env = dict(os.environ, LGBMTPU_LINT_ONLY="1")
+    proc = subprocess.run(
+        [sys.executable, "-m", "lightgbm_tpu.analysis", "--format=json"],
+        cwd=os.path.dirname(os.path.abspath(__file__)), env=env,
+        capture_output=True, text=True)
+    if proc.returncode != 0:
+        doc = {}
+        try:
+            doc = json.loads(proc.stdout)
+        except ValueError:
+            pass
+        for f in doc.get("findings", []) + doc.get("parse_errors", []):
+            print(f"# tpu-lint {f['path']}:{f['line']}: [{f['rule']}] "
+                  f"{f['message']}", file=sys.stderr)
+        sys.exit(f"bench aborted: tpu-lint found "
+                 f"{doc.get('summary', {}).get('findings', '?')} violation(s)"
+                 " — fix them (or LGBM_TPU_BENCH_SKIP_LINT=1 to bypass)")
+
+
 def main():
+    _lint_preflight()
     n_rows = int(os.environ.get("LGBM_TPU_BENCH_ROWS", 10_000_000))
     n_iters = int(os.environ.get("LGBM_TPU_BENCH_ITERS", 20))
     num_leaves = int(os.environ.get("LGBM_TPU_BENCH_LEAVES", 255))

@@ -72,7 +72,9 @@ def _ref_cli_predict(ref_cli, booster, X, workdir):
     np.savetxt(data_path, np.column_stack([np.zeros(X.shape[0]), X]),
                delimiter="\t", fmt="%.9g")
     conf = os.path.join(workdir, "predict.conf")
-    with open(conf, "w") as fh:
+    # transient conf inside the caller's tempdir, consumed by the subprocess
+    # right below — torn-write durability does not apply
+    with open(conf, "w") as fh:   # tpu-lint: disable=non-atomic-artifact-write
         fh.write(f"task=predict\ndata={data_path}\n"
                  f"input_model={model_path}\noutput_result={out_path}\n")
     t0 = time.perf_counter()
@@ -193,9 +195,9 @@ def main():
         **({"vs_ref_cli": doc["vs_ref_cli"]} if "vs_ref_cli" in doc else {}),
     }))
     if args.out:
-        with open(args.out, "w") as fh:
-            json.dump(doc, fh, indent=1)
-            fh.write("\n")
+        from lightgbm_tpu.utils import atomic_io
+        atomic_io.atomic_write_text(args.out,
+                                    json.dumps(doc, indent=1) + "\n")
         print(f"# wrote {args.out}", file=sys.stderr)
 
 

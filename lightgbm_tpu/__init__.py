@@ -12,69 +12,15 @@ Dataset, Booster, train, cv, the sklearn wrappers, callbacks, and plotting.
 
 import os as _os
 
-
-def _enable_persistent_compile_cache() -> None:
-    """Persistent XLA compilation cache (VERDICT r3 weak #4: bench/CLI paid a
-    ~116 s cold compile every run while only tests wired the cache). Applied at
-    import so every entry point (CLI, bench.py, python API) benefits. Opt out
-    with LGBM_TPU_NO_COMPILE_CACHE=1; override dir with LGBM_TPU_JAX_CACHE."""
-    if _os.environ.get("LGBM_TPU_NO_COMPILE_CACHE"):
-        return
-    cache = _os.environ.get("LGBM_TPU_JAX_CACHE")
-    if not cache:
-        # prefer a repo-local dir (survives with the checkout across rounds),
-        # fall back to the user cache dir
-        repo_root = _os.path.dirname(_os.path.dirname(_os.path.abspath(__file__)))
-        cand = _os.path.join(repo_root, ".jax_cache")
-        try:
-            _os.makedirs(cand, exist_ok=True)
-            cache = cand
-        except OSError:
-            try:
-                cache = _os.path.join(_os.path.expanduser("~"), ".cache",
-                                      "lightgbm_tpu_jax")
-                _os.makedirs(cache, exist_ok=True)
-            except OSError:
-                return   # nowhere writable: run without the cache
-    try:
-        import jax
-        jax.config.update("jax_compilation_cache_dir", cache)
-        # default 1.0 s skips tiny programs; the test suite lowers this via
-        # the env knob so its many sub-second predict/eval programs persist
-        # across runs instead of recompiling every session
-        min_s = float(_os.environ.get("LGBM_TPU_JAX_CACHE_MIN_COMPILE_S", "1.0"))
-        jax.config.update("jax_persistent_cache_min_compile_time_secs", min_s)
-        jax.config.update("jax_persistent_cache_enable_xla_caches", "all")
-    except Exception:  # pragma: no cover - cache is an optimization only
-        pass
-
-
-_enable_persistent_compile_cache()
-
-from .basic import Booster, Dataset
-from .callback import (EarlyStopException, early_stopping, log_evaluation,
-                       print_evaluation, record_evaluation, reset_parameter)
-from .config import Config
-from .engine import cv, train
-from .utils import log
-from .utils.log import LightGBMError
-
-try:
-    from .sklearn import LGBMClassifier, LGBMModel, LGBMRanker, LGBMRegressor
-    _SKLEARN_OK = True
-except ImportError:  # pragma: no cover
-    _SKLEARN_OK = False
-
-try:
-    from .plotting import (plot_importance, plot_metric, plot_split_value_histogram,
-                           plot_tree, create_tree_digraph)
-except ImportError:  # pragma: no cover
-    pass
-
 __version__ = "0.1.0"
 
-__all__ = ["Dataset", "Booster", "Config", "train", "cv",
-           "LightGBMError",
-           "early_stopping", "print_evaluation", "log_evaluation",
-           "record_evaluation", "reset_parameter", "EarlyStopException",
-           "LGBMModel", "LGBMClassifier", "LGBMRegressor", "LGBMRanker"]
+if _os.environ.get("LGBMTPU_LINT_ONLY"):
+    # Lint-only mode: ``python -m lightgbm_tpu.analysis`` must import this
+    # parent package (that is how -m works) but the analyzer is pure-stdlib
+    # AST and must never pull in jax — it runs as a <10 s tier-1 check and as
+    # bench.py's preflight. Skip the jax-touching API surface entirely; the
+    # analysis subpackage imports nothing from it.
+    __all__ = []
+else:
+    from ._api import *          # noqa: F401,F403  (the real package surface)
+    from ._api import __all__    # noqa: F401

@@ -1,0 +1,18 @@
+"""tpu-lint: static analysis for JAX/TPU GBDT hazard classes.
+
+Run ``LGBMTPU_LINT_ONLY=1 python -m lightgbm_tpu.analysis`` (JAX-free), or
+use :func:`analyze_source` / :func:`analyze_paths` in-process (tests,
+bench.py preflight). See docs/STATIC_ANALYSIS.md for the rule catalogue and
+the suppression/baseline workflow.
+"""
+from .core import (AnalysisResult, BaselineEntry, Finding, ModuleContext,
+                   Rule, all_rules, analyze_paths, analyze_source,
+                   event_schemas, load_baseline, main, nonfinite_policies,
+                   register, registered_params, render_human, render_json)
+
+__all__ = [
+    "AnalysisResult", "BaselineEntry", "Finding", "ModuleContext", "Rule",
+    "all_rules", "analyze_paths", "analyze_source", "event_schemas",
+    "load_baseline", "main", "nonfinite_policies", "register",
+    "registered_params", "render_human", "render_json",
+]

@@ -1,0 +1,12 @@
+"""CLI entry: ``LGBMTPU_LINT_ONLY=1 python -m lightgbm_tpu.analysis``.
+
+The env var short-circuits the parent package's JAX initialization so the
+lint pass stays import-light (no jax in sys.modules); see
+lightgbm_tpu/__init__.py.
+"""
+import sys
+
+from .core import main
+
+if __name__ == "__main__":
+    sys.exit(main())

@@ -1,0 +1,696 @@
+"""tpu-lint core: rule registry, AST driver, suppressions, baseline, reporters.
+
+This package is the unified static-analysis pass for the JAX/TPU GBDT hazard
+classes that used to be guarded by hand (or by one-off scripts): hidden
+host<->device syncs inside jitted code, XLA retrace hazards, float64 dtype
+drift onto device paths, unregistered config params, non-atomic artifact
+writes, unlocked module-level shared state, and telemetry-schema violations.
+
+Design constraints (enforced by tests/test_static_analysis.py):
+
+- **No JAX import.** Everything here is pure stdlib ``ast``/``tokenize`` over
+  source text; facts about the package (registered params, event schemas) are
+  extracted by parsing ``config.py`` / ``obs/events.py`` as ASTs, never by
+  importing them. ``LGBMTPU_LINT_ONLY=1 python -m lightgbm_tpu.analysis``
+  runs without ``jax`` ever entering ``sys.modules``.
+- **Fast.** One parse per file, one shared walk per rule; the whole repo
+  analyzes in well under 10 s so it can run as a tier-1 test and as
+  bench.py's preflight.
+
+Workflow surfaces:
+
+- inline suppression: ``# tpu-lint: disable=<rule>[,<rule>...]`` on the
+  flagged line (or on a standalone comment line directly above it);
+  ``# tpu-lint: disable-file=<rule>`` anywhere suppresses for the module.
+- baseline: grandfathered findings live in ``baseline.json`` next to this
+  module, keyed by (rule, path, source-line text) so entries survive line
+  drift; every entry carries a human justification. ``--update-baseline``
+  regenerates entries (preserving justifications for findings that remain);
+  a baseline entry whose finding disappeared becomes a ``stale-baseline``
+  finding, so fixed code forces baseline cleanup.
+"""
+from __future__ import annotations
+
+import ast
+import dataclasses
+import io
+import json
+import os
+import re
+import time
+import tokenize
+from typing import Any, Dict, Iterable, List, Optional, Sequence, Set, Tuple
+
+REPO_ROOT = os.path.dirname(os.path.dirname(os.path.dirname(
+    os.path.abspath(__file__))))
+PKG_DIR = os.path.join(REPO_ROOT, "lightgbm_tpu")
+DEFAULT_BASELINE = os.path.join(os.path.dirname(os.path.abspath(__file__)),
+                                "baseline.json")
+# the default scan surface: the package plus the committed entry-point
+# scripts whose artifact writes the non-atomic-write rule audits
+DEFAULT_PATHS = ("lightgbm_tpu", "bench.py", "bench_predict.py", "scripts")
+
+SEVERITIES = ("error", "warning")
+
+_SUPPRESS_RE = re.compile(r"#\s*tpu-lint:\s*disable=([\w\-, ]+)")
+_SUPPRESS_FILE_RE = re.compile(r"#\s*tpu-lint:\s*disable-file=([\w\-, ]+)")
+
+
+@dataclasses.dataclass(frozen=True)
+class Finding:
+    rule: str
+    path: str          # repo-relative, forward slashes
+    line: int          # 1-based
+    message: str
+    severity: str = "error"
+
+    def to_dict(self) -> Dict[str, Any]:
+        return dataclasses.asdict(self)
+
+    def render(self) -> str:
+        return f"{self.path}:{self.line}: [{self.severity}] " \
+               f"{self.rule}: {self.message}"
+
+
+class Rule:
+    """One hazard class. Subclasses set ``name``/``severity``/``description``
+    /``rationale`` and implement :meth:`check_module` (AST rules) or
+    :meth:`run_dynamic` (runtime smoke rules, gated behind ``--dynamic``)."""
+
+    name: str = ""
+    severity: str = "error"
+    description: str = ""
+    rationale: str = ""
+    kind: str = "ast"            # "ast" | "dynamic"
+
+    def check_module(self, ctx: "ModuleContext") -> None:
+        raise NotImplementedError
+
+    def run_dynamic(self) -> List[Finding]:   # pragma: no cover - per rule
+        raise NotImplementedError
+
+
+_REGISTRY: Dict[str, Rule] = {}
+
+
+def register(cls):
+    """Class decorator adding a rule (as a singleton instance) to the
+    registry; the registry order is the report order."""
+    inst = cls()
+    if not inst.name:
+        raise ValueError(f"rule {cls.__name__} has no name")
+    if inst.name in _REGISTRY:
+        raise ValueError(f"duplicate rule name {inst.name!r}")
+    _REGISTRY[inst.name] = inst
+    return cls
+
+
+def all_rules() -> Dict[str, Rule]:
+    """Rule name -> instance; importing the rules package populates it."""
+    from . import rules as _rules  # noqa: F401  (registration side effect)
+    return dict(_REGISTRY)
+
+
+# ---------------------------------------------------------------------------
+# per-module context
+
+
+class ModuleContext:
+    """Everything a rule needs about one module: the AST, source lines,
+    parent links, import aliases, and a ``report`` sink."""
+
+    def __init__(self, relpath: str, source: str):
+        self.relpath = relpath.replace(os.sep, "/")
+        self.source = source
+        self.lines = source.splitlines()
+        self.tree = ast.parse(source, filename=relpath)
+        self.findings: List[Finding] = []
+        self.parents: Dict[ast.AST, ast.AST] = {}
+        for parent in ast.walk(self.tree):
+            for child in ast.iter_child_nodes(parent):
+                self.parents[child] = parent
+        self.numpy_aliases, self.jnp_aliases, self.jax_aliases = \
+            _import_aliases(self.tree)
+        self.line_suppressions, self.file_suppressions = \
+            _parse_suppressions(source)
+
+    # -- reporting --
+    def report(self, rule: Rule, node: Any, message: str,
+               severity: Optional[str] = None) -> None:
+        line = node if isinstance(node, int) else getattr(node, "lineno", 1)
+        self.findings.append(Finding(
+            rule=rule.name, path=self.relpath, line=line, message=message,
+            severity=severity or rule.severity))
+
+    # -- helpers rules share --
+    def ancestors(self, node: ast.AST) -> Iterable[ast.AST]:
+        cur = self.parents.get(node)
+        while cur is not None:
+            yield cur
+            cur = self.parents.get(cur)
+
+    def is_np_attr(self, node: ast.AST, attr: Optional[str] = None) -> bool:
+        """``node`` is ``np.<attr>`` for any imported numpy alias."""
+        return (isinstance(node, ast.Attribute)
+                and isinstance(node.value, ast.Name)
+                and node.value.id in self.numpy_aliases
+                and (attr is None or node.attr == attr))
+
+    def is_jnp_attr(self, node: ast.AST, attr: Optional[str] = None) -> bool:
+        return (isinstance(node, ast.Attribute)
+                and isinstance(node.value, ast.Name)
+                and node.value.id in self.jnp_aliases
+                and (attr is None or node.attr == attr))
+
+    def mentions_device_api(self, node: ast.AST) -> bool:
+        """Subtree references jax/jnp (device work happens near here)."""
+        for sub in ast.walk(node):
+            if isinstance(sub, ast.Name) and \
+                    sub.id in (self.jnp_aliases | self.jax_aliases):
+                return True
+        return False
+
+    def code_at(self, line: int) -> str:
+        if 1 <= line <= len(self.lines):
+            return self.lines[line - 1].strip()
+        return ""
+
+    def is_suppressed(self, f: Finding) -> bool:
+        if f.rule in self.file_suppressions or \
+                "all" in self.file_suppressions:
+            return True
+        rules = self.line_suppressions.get(f.line, ())
+        return f.rule in rules or "all" in rules
+
+
+def _import_aliases(tree: ast.Module) -> Tuple[Set[str], Set[str], Set[str]]:
+    numpy_a, jnp_a, jax_a = set(), set(), set()
+    for node in ast.walk(tree):
+        if isinstance(node, ast.Import):
+            for a in node.names:
+                name = a.asname or a.name.split(".")[0]
+                if a.name == "numpy":
+                    numpy_a.add(name)
+                elif a.name == "jax.numpy":
+                    jnp_a.add(a.asname or "jax")
+                elif a.name == "jax" or a.name.startswith("jax."):
+                    jax_a.add(name)
+        elif isinstance(node, ast.ImportFrom):
+            if node.module == "jax" and any(a.name == "numpy"
+                                            for a in node.names):
+                for a in node.names:
+                    if a.name == "numpy":
+                        jnp_a.add(a.asname or "numpy")
+    return numpy_a, jnp_a, jax_a
+
+
+def _parse_suppressions(source: str) -> Tuple[Dict[int, Set[str]], Set[str]]:
+    """Map line -> suppressed rule names (a standalone comment also covers
+    the next line), plus the module-wide set from ``disable-file=``."""
+    per_line: Dict[int, Set[str]] = {}
+    whole_file: Set[str] = set()
+    try:
+        tokens = list(tokenize.generate_tokens(io.StringIO(source).readline))
+    except tokenize.TokenizeError:   # pragma: no cover - ast.parse ran first
+        return per_line, whole_file
+    for tok in tokens:
+        if tok.type != tokenize.COMMENT:
+            continue
+        m = _SUPPRESS_FILE_RE.search(tok.string)
+        if m:
+            whole_file.update(r.strip() for r in m.group(1).split(",")
+                              if r.strip())
+            continue
+        m = _SUPPRESS_RE.search(tok.string)
+        if not m:
+            continue
+        rules = {r.strip() for r in m.group(1).split(",") if r.strip()}
+        line = tok.start[0]
+        per_line.setdefault(line, set()).update(rules)
+        # a comment alone on its line shields the following line too
+        if tok.line.strip().startswith("#"):
+            per_line.setdefault(line + 1, set()).update(rules)
+    return per_line, whole_file
+
+
+# ---------------------------------------------------------------------------
+# shared AST predicates (used by several rules)
+
+
+def is_jit_expr(node: ast.AST) -> bool:
+    """``jax.jit`` / bare ``jit`` reference (not a call)."""
+    if isinstance(node, ast.Attribute) and node.attr == "jit":
+        return True
+    return isinstance(node, ast.Name) and node.id == "jit"
+
+
+def jit_call_info(node: ast.AST) -> Optional[ast.Call]:
+    """If ``node`` is a call that produces a jitted function —
+    ``jax.jit(...)`` or ``partial(jax.jit, ...)`` — return that Call."""
+    if not isinstance(node, ast.Call):
+        return None
+    if is_jit_expr(node.func):
+        return node
+    f = node.func
+    is_partial = (isinstance(f, ast.Name) and f.id == "partial") or \
+        (isinstance(f, ast.Attribute) and f.attr == "partial")
+    if is_partial and node.args and is_jit_expr(node.args[0]):
+        return node
+    return None
+
+
+def decorator_jit_call(dec: ast.AST) -> Optional[ast.Call]:
+    """Jit decorator forms: ``@jax.jit``, ``@jit``, ``@jax.jit(...)``,
+    ``@partial(jax.jit, ...)``. Returns the Call carrying kwargs (or None
+    for the bare form, which has none)."""
+    if is_jit_expr(dec):
+        return None
+    return jit_call_info(dec)
+
+
+def is_jit_decorated(fn: ast.AST) -> bool:
+    return any(is_jit_expr(d) or jit_call_info(d) is not None
+               for d in getattr(fn, "decorator_list", ()))
+
+
+def static_names_from_call(call: Optional[ast.Call],
+                           fn: Optional[ast.AST]) -> Set[str]:
+    """Parameter names declared static via static_argnames/static_argnums."""
+    out: Set[str] = set()
+    if call is None:
+        return out
+    params: List[str] = []
+    if fn is not None and isinstance(fn, (ast.FunctionDef,
+                                          ast.AsyncFunctionDef, ast.Lambda)):
+        a = fn.args
+        params = [p.arg for p in a.posonlyargs + a.args]
+    for kw in call.keywords:
+        if kw.arg == "static_argnames":
+            for sub in ast.walk(kw.value):
+                if isinstance(sub, ast.Constant) and isinstance(sub.value,
+                                                                str):
+                    out.add(sub.value)
+        elif kw.arg == "static_argnums":
+            for sub in ast.walk(kw.value):
+                if isinstance(sub, ast.Constant) and \
+                        isinstance(sub.value, int) and \
+                        0 <= sub.value < len(params):
+                    out.add(params[sub.value])
+    return out
+
+
+def root_name(node: ast.AST) -> Optional[str]:
+    """Leftmost Name of an attribute/subscript chain (``a.b[0].c`` -> a)."""
+    while isinstance(node, (ast.Attribute, ast.Subscript)):
+        node = node.value
+    return node.id if isinstance(node, ast.Name) else None
+
+
+# ---------------------------------------------------------------------------
+# package facts, extracted WITHOUT importing the package
+
+
+_FACT_CACHE: Dict[str, Any] = {}
+
+
+def registered_params(config_path: Optional[str] = None) -> Set[str]:
+    """Canonical names + aliases from config.py's ``_PARAMS`` literal."""
+    path = config_path or os.path.join(PKG_DIR, "config.py")
+    key = "params:" + path
+    if key in _FACT_CACHE:
+        return _FACT_CACHE[key]
+    names: Set[str] = set()
+    tree = _parse_file(path)
+    if tree is not None:
+        for node in ast.walk(tree):
+            if isinstance(node, (ast.Assign, ast.AnnAssign)):
+                targets = node.targets if isinstance(node, ast.Assign) \
+                    else [node.target]
+                if not (any(isinstance(t, ast.Name) and t.id == "_PARAMS"
+                            for t in targets)
+                        and isinstance(node.value, ast.Dict)):
+                    continue
+                for k, v in zip(node.value.keys, node.value.values):
+                    if isinstance(k, ast.Constant) and isinstance(k.value,
+                                                                  str):
+                        names.add(k.value)
+                    if isinstance(v, ast.Tuple) and len(v.elts) == 2:
+                        for alias in ast.walk(v.elts[1]):
+                            if isinstance(alias, ast.Constant) and \
+                                    isinstance(alias.value, str):
+                                names.add(alias.value)
+    _FACT_CACHE[key] = names
+    return names
+
+
+def nonfinite_policies(config_path: Optional[str] = None) -> Set[str]:
+    """Legal nonfinite_policy literals, read from the validation tuple in
+    config.py's ``_post_process`` (falls back to the known trio)."""
+    path = config_path or os.path.join(PKG_DIR, "config.py")
+    key = "nfpol:" + path
+    if key in _FACT_CACHE:
+        return _FACT_CACHE[key]
+    out: Set[str] = set()
+    tree = _parse_file(path)
+    if tree is not None:
+        for node in ast.walk(tree):
+            if not isinstance(node, ast.Compare):
+                continue
+            left = node.left
+            if isinstance(left, ast.Attribute) and \
+                    left.attr == "nonfinite_policy":
+                for comp in node.comparators:
+                    for sub in ast.walk(comp):
+                        if isinstance(sub, ast.Constant) and \
+                                isinstance(sub.value, str):
+                            out.add(sub.value)
+    _FACT_CACHE[key] = out or {"fatal", "warn_skip_tree", "clip"}
+    return _FACT_CACHE[key]
+
+
+def event_schemas(events_path: Optional[str] = None) \
+        -> Dict[str, Tuple[Set[str], Set[str]]]:
+    """Event type -> (required field names, optional field names), parsed
+    from the ``EVENT_SCHEMAS`` literal in obs/events.py."""
+    path = events_path or os.path.join(PKG_DIR, "obs", "events.py")
+    key = "events:" + path
+    if key in _FACT_CACHE:
+        return _FACT_CACHE[key]
+    schemas: Dict[str, Tuple[Set[str], Set[str]]] = {}
+    tree = _parse_file(path)
+
+    def dict_keys(d: ast.AST) -> Set[str]:
+        return {k.value for k in getattr(d, "keys", ())
+                if isinstance(k, ast.Constant) and isinstance(k.value, str)}
+
+    if tree is not None:
+        for node in ast.walk(tree):
+            if isinstance(node, (ast.Assign, ast.AnnAssign)):
+                targets = node.targets if isinstance(node, ast.Assign) \
+                    else [node.target]
+                if not any(isinstance(t, ast.Name) and t.id == "EVENT_SCHEMAS"
+                           for t in targets):
+                    continue
+                val = node.value
+                if not isinstance(val, ast.Dict):
+                    continue
+                for k, v in zip(val.keys, val.values):
+                    if isinstance(k, ast.Constant) and \
+                            isinstance(k.value, str) and \
+                            isinstance(v, ast.Tuple) and len(v.elts) == 2:
+                        schemas[k.value] = (dict_keys(v.elts[0]),
+                                            dict_keys(v.elts[1]))
+    _FACT_CACHE[key] = schemas
+    return schemas
+
+
+def _parse_file(path: str) -> Optional[ast.Module]:
+    try:
+        with open(path) as fh:
+            return ast.parse(fh.read(), filename=path)
+    except (OSError, SyntaxError):
+        return None
+
+
+# ---------------------------------------------------------------------------
+# baseline
+
+
+@dataclasses.dataclass
+class BaselineEntry:
+    rule: str
+    path: str
+    line: int          # advisory; matching is by (rule, path, code)
+    code: str          # stripped source line at the finding
+    justification: str
+
+    def to_dict(self) -> Dict[str, Any]:
+        return dataclasses.asdict(self)
+
+
+def load_baseline(path: str) -> List[BaselineEntry]:
+    if not path or not os.path.exists(path):
+        return []
+    with open(path) as fh:
+        doc = json.load(fh)
+    return [BaselineEntry(rule=e["rule"], path=e["path"],
+                          line=int(e.get("line", 0)),
+                          code=e.get("code", ""),
+                          justification=e.get("justification", ""))
+            for e in doc.get("entries", [])]
+
+
+def baseline_key(f: Finding, code: str) -> Tuple[str, str, str]:
+    return (f.rule, f.path, code)
+
+
+# ---------------------------------------------------------------------------
+# driver
+
+
+@dataclasses.dataclass
+class AnalysisResult:
+    findings: List[Finding]                  # live (post-suppress, -baseline)
+    suppressed: List[Finding]
+    baselined: List[Finding]
+    stale_baseline: List[BaselineEntry]
+    parse_errors: List[Finding]
+    files: int
+    elapsed_s: float
+
+    @property
+    def failed(self) -> bool:
+        return bool(self.findings or self.parse_errors
+                    or self.stale_baseline)
+
+    def to_dict(self) -> Dict[str, Any]:
+        return {
+            "version": 1,
+            "findings": [f.to_dict() for f in self.findings],
+            "parse_errors": [f.to_dict() for f in self.parse_errors],
+            "stale_baseline": [e.to_dict() for e in self.stale_baseline],
+            "summary": {
+                "files": self.files,
+                "findings": len(self.findings),
+                "suppressed": len(self.suppressed),
+                "baselined": len(self.baselined),
+                "stale_baseline": len(self.stale_baseline),
+                "elapsed_s": round(self.elapsed_s, 3),
+                "ok": not self.failed,
+            },
+        }
+
+
+def iter_python_files(paths: Sequence[str], root: str = REPO_ROOT) \
+        -> List[str]:
+    """Expand files/directories (relative to ``root``) into sorted .py
+    paths; hidden dirs and __pycache__ are skipped."""
+    out: List[str] = []
+    for p in paths:
+        full = p if os.path.isabs(p) else os.path.join(root, p)
+        if os.path.isfile(full):
+            out.append(full)
+            continue
+        for dirpath, dirnames, filenames in os.walk(full):
+            dirnames[:] = [d for d in dirnames
+                           if not d.startswith(".") and d != "__pycache__"]
+            for fn in filenames:
+                if fn.endswith(".py"):
+                    out.append(os.path.join(dirpath, fn))
+    return sorted(set(out))
+
+
+def analyze_source(source: str, relpath: str = "<fixture>",
+                   rules: Optional[Sequence[str]] = None,
+                   keep_suppressed: bool = False) -> List[Finding]:
+    """Analyze one source string (the fixture-test entry point). Returns
+    live findings; with ``keep_suppressed`` returns suppressed ones too."""
+    live, suppressed = _analyze_module(relpath, source, _select(rules))
+    return live + (suppressed if keep_suppressed else [])
+
+
+def analyze_paths(paths: Optional[Sequence[str]] = None,
+                  rules: Optional[Sequence[str]] = None,
+                  baseline_path: Optional[str] = DEFAULT_BASELINE,
+                  root: str = REPO_ROOT) -> AnalysisResult:
+    t0 = time.perf_counter()
+    chosen = _select(rules)
+    files = iter_python_files(paths or DEFAULT_PATHS, root=root)
+    live: List[Finding] = []
+    suppressed: List[Finding] = []
+    parse_errors: List[Finding] = []
+    code_of: Dict[Finding, str] = {}
+    for full in files:
+        rel = os.path.relpath(full, root).replace(os.sep, "/")
+        try:
+            with open(full, encoding="utf-8") as fh:
+                src = fh.read()
+        except (OSError, UnicodeDecodeError) as e:
+            parse_errors.append(Finding("parse", rel, 1,
+                                        f"unreadable: {e}", "error"))
+            continue
+        try:
+            file_live, file_supp = _analyze_module(rel, src, chosen,
+                                                   code_of=code_of)
+        except SyntaxError as e:
+            parse_errors.append(Finding("parse", rel, e.lineno or 1,
+                                        f"does not parse: {e.msg}", "error"))
+            continue
+        live.extend(file_live)
+        suppressed.extend(file_supp)
+
+    baseline = load_baseline(baseline_path) if baseline_path else []
+    by_key: Dict[Tuple[str, str, str], List[BaselineEntry]] = {}
+    for e in baseline:
+        by_key.setdefault((e.rule, e.path, e.code), []).append(e)
+    matched: Set[int] = set()
+    remaining: List[Finding] = []
+    baselined: List[Finding] = []
+    for f in live:
+        entries = by_key.get(baseline_key(f, code_of.get(f, "")))
+        if entries:
+            matched.update(id(e) for e in entries)
+            baselined.append(f)
+        else:
+            remaining.append(f)
+    stale = [e for e in baseline if id(e) not in matched]
+    return AnalysisResult(findings=remaining, suppressed=suppressed,
+                          baselined=baselined, stale_baseline=stale,
+                          parse_errors=parse_errors, files=len(files),
+                          elapsed_s=time.perf_counter() - t0)
+
+
+def _select(rules: Optional[Sequence[str]]) -> List[Rule]:
+    table = all_rules()
+    if rules is None:
+        return [r for r in table.values() if r.kind == "ast"]
+    missing = [n for n in rules if n not in table]
+    if missing:
+        raise KeyError(f"unknown rule(s): {', '.join(missing)} "
+                       f"(known: {', '.join(sorted(table))})")
+    return [table[n] for n in rules if table[n].kind == "ast"]
+
+
+def _analyze_module(relpath: str, source: str, rules: List[Rule],
+                    code_of: Optional[Dict[Finding, str]] = None) \
+        -> Tuple[List[Finding], List[Finding]]:
+    ctx = ModuleContext(relpath, source)
+    for rule in rules:
+        rule.check_module(ctx)
+    live, suppressed = [], []
+    for f in sorted(ctx.findings, key=lambda f: (f.line, f.rule)):
+        if code_of is not None:
+            code_of[f] = ctx.code_at(f.line)
+        (suppressed if ctx.is_suppressed(f) else live).append(f)
+    return live, suppressed
+
+
+# ---------------------------------------------------------------------------
+# reporters / CLI
+
+
+def render_human(res: AnalysisResult) -> str:
+    lines: List[str] = []
+    for f in res.parse_errors + res.findings:
+        lines.append("FAIL " + f.render())
+    for e in res.stale_baseline:
+        lines.append(f"FAIL {e.path}:{e.line}: [error] stale-baseline: "
+                     f"baseline entry for rule {e.rule!r} no longer matches "
+                     f"any finding — remove it (code was: {e.code!r})")
+    status = "FAIL" if res.failed else "PASS"
+    lines.append(f"{status} tpu-lint: {res.files} files, "
+                 f"{len(res.findings)} finding(s), "
+                 f"{len(res.suppressed)} suppressed, "
+                 f"{len(res.baselined)} baselined, "
+                 f"{len(res.stale_baseline)} stale baseline entr(ies) "
+                 f"in {res.elapsed_s:.2f}s")
+    return "\n".join(lines)
+
+
+def render_json(res: AnalysisResult) -> str:
+    return json.dumps(res.to_dict(), sort_keys=True)
+
+
+def _update_baseline(res: AnalysisResult, baseline_path: str,
+                     root: str) -> int:
+    """Regenerate the baseline from current live findings, keeping the
+    justification of entries that still match; new entries get a TODO
+    justification the author must replace."""
+    old = load_baseline(baseline_path)
+    just: Dict[Tuple[str, str, str], str] = {
+        (e.rule, e.path, e.code): e.justification for e in old}
+    entries: List[Dict[str, Any]] = []
+    src_cache: Dict[str, List[str]] = {}
+    for f in res.findings + res.baselined:
+        if f.path not in src_cache:
+            try:
+                with open(os.path.join(root, f.path)) as fh:
+                    src_cache[f.path] = fh.read().splitlines()
+            except OSError:
+                src_cache[f.path] = []
+        lines = src_cache[f.path]
+        code = lines[f.line - 1].strip() if f.line <= len(lines) else ""
+        entries.append(BaselineEntry(
+            rule=f.rule, path=f.path, line=f.line, code=code,
+            justification=just.get((f.rule, f.path, code),
+                                   "TODO: justify or fix")).to_dict())
+    entries.sort(key=lambda e: (e["path"], e["line"], e["rule"]))
+    doc = {"version": 1,
+           "comment": "tpu-lint grandfathered findings; each entry needs a "
+                      "justification. Regenerate with --update-baseline.",
+           "entries": entries}
+    tmp = baseline_path + ".tmp"
+    with open(tmp, "w") as fh:   # tpu-lint: disable=non-atomic-artifact-write
+        json.dump(doc, fh, indent=1, sort_keys=True)
+        fh.write("\n")
+    os.replace(tmp, baseline_path)
+    print(f"wrote {len(entries)} baseline entr(ies) to {baseline_path}")
+    return 0
+
+
+def main(argv: Optional[Sequence[str]] = None) -> int:
+    import argparse
+    ap = argparse.ArgumentParser(
+        prog="python -m lightgbm_tpu.analysis",
+        description="tpu-lint: static analysis for JAX/TPU GBDT hazards")
+    ap.add_argument("paths", nargs="*", default=None,
+                    help="files/dirs to scan (default: the repo surface)")
+    ap.add_argument("--format", choices=("human", "json"), default="human")
+    ap.add_argument("--rules", default=None,
+                    help="comma-separated rule subset")
+    ap.add_argument("--baseline", default=DEFAULT_BASELINE,
+                    help="baseline file ('none' disables)")
+    ap.add_argument("--update-baseline", action="store_true",
+                    help="rewrite the baseline from current findings")
+    ap.add_argument("--list-rules", action="store_true")
+    ap.add_argument("--dynamic", action="store_true",
+                    help="also run dynamic (runtime smoke) rules; these "
+                         "import the package, and therefore JAX")
+    args = ap.parse_args(argv)
+
+    if args.list_rules:
+        for name, rule in sorted(all_rules().items()):
+            print(f"{name:28s} [{rule.kind}/{rule.severity}] "
+                  f"{rule.description}")
+        return 0
+
+    rules = [r.strip() for r in args.rules.split(",")] if args.rules else None
+    baseline = None if args.baseline == "none" else args.baseline
+    if args.update_baseline:
+        res = analyze_paths(args.paths or None, rules=rules,
+                            baseline_path=None)
+        return _update_baseline(res, baseline or DEFAULT_BASELINE, REPO_ROOT)
+
+    res = analyze_paths(args.paths or None, rules=rules,
+                        baseline_path=baseline)
+    rc = 1 if res.failed else 0
+    if args.dynamic:
+        dyn_findings: List[Finding] = []
+        for rule in all_rules().values():
+            if rule.kind != "dynamic" or (rules and rule.name not in rules):
+                continue
+            dyn_findings.extend(rule.run_dynamic())
+        res.findings.extend(dyn_findings)
+        rc = 1 if res.failed else rc
+    print(render_json(res) if args.format == "json" else render_human(res))
+    return rc

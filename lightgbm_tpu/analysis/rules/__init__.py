@@ -1,0 +1,5 @@
+"""tpu-lint rule battery. Importing this package registers every rule with
+``core._REGISTRY``; each module holds one hazard class and documents the
+production incident it guards against (see docs/STATIC_ANALYSIS.md)."""
+from . import (atomic_write, dtype_drift, host_sync, nonfinite, params,  # noqa: F401
+               retrace, shared_state, telemetry)

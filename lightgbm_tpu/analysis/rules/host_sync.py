@@ -1,0 +1,157 @@
+"""Rule: host-sync-in-jit — host materialization inside jitted code.
+
+A ``.item()`` / ``np.asarray`` / ``jax.device_get`` on a traced value inside
+a ``@jax.jit`` function either fails at trace time (ConcretizationTypeError)
+or, worse, silently forces a blocking device->host transfer per call when it
+lands on a constant-folded path — the exact "hidden sync in the training
+loop" class that profiler archaeology used to find. The rule walks every
+jitted function (decorated, ``jax.jit(f)``-wrapped, or a jitted lambda) and
+flags host-materializing calls; arguments rooted at ``static_argnames`` /
+``static_argnums`` parameters are exempt (static args are Python values, so
+``float(gp.learning_rate)`` inside a jit with ``static_argnames=("gp",)`` is
+legitimate).
+
+It also audits the designated host-side hot loops (``engine.train``'s
+boosting loop) for per-iteration syncs: ``.item()``, ``block_until_ready``,
+``device_get`` in that loop stall the async dispatch pipeline the lagged
+telemetry design exists to protect.
+"""
+from __future__ import annotations
+
+import ast
+from typing import List, Optional, Set, Tuple
+
+from ..core import (ModuleContext, Rule, decorator_jit_call, is_jit_decorated,
+                    is_jit_expr, jit_call_info, register, root_name,
+                    static_names_from_call)
+
+# host-materializing method names on (potentially traced) values
+_SYNC_METHODS = {"item", "tolist", "block_until_ready"}
+# builtin casts that concretize a traced value
+_SYNC_BUILTINS = {"float", "int", "bool"}
+# host-side loops audited for per-iteration syncs: (path, function name)
+HOT_LOOPS: Set[Tuple[str, str]] = {("lightgbm_tpu/engine.py", "train")}
+
+
+@register
+class HostSyncInJit(Rule):
+    name = "host-sync-in-jit"
+    severity = "error"
+    description = ("host materialization (.item()/np.asarray/device_get/"
+                   "float()) inside a jitted function or a hot host loop")
+    rationale = ("hidden host<->device syncs serialize the async dispatch "
+                 "pipeline; one .item() per iteration erases the TPU win")
+
+    def check_module(self, ctx: ModuleContext) -> None:
+        jitted = _collect_jitted(ctx)
+        for fn, static_names in jitted:
+            self._check_jit_body(ctx, fn, static_names)
+        for node in ast.walk(ctx.tree):
+            if isinstance(node, (ast.FunctionDef, ast.AsyncFunctionDef)) and \
+                    (ctx.relpath, node.name) in HOT_LOOPS:
+                self._check_hot_loop(ctx, node)
+
+    # -- jitted function bodies --
+    def _check_jit_body(self, ctx: ModuleContext, fn: ast.AST,
+                        static_names: Set[str]) -> None:
+        body = fn.body if isinstance(fn.body, list) else [fn.body]
+        for stmt in body:
+            for node in ast.walk(stmt):
+                if not isinstance(node, ast.Call):
+                    continue
+                f = node.func
+                if isinstance(f, ast.Attribute) and \
+                        f.attr in _SYNC_METHODS and not node.args:
+                    ctx.report(self, node,
+                               f".{f.attr}() inside a jitted function forces "
+                               "a host sync (or fails at trace time); keep "
+                               "device values traced and read them outside "
+                               "the jit")
+                elif ctx.is_np_attr(f) and _has_nonconst_arg(node):
+                    ctx.report(self, node,
+                               f"numpy call np.{f.attr}(...) on a non-"
+                               "constant value inside a jitted function "
+                               "materializes the operand on host; use "
+                               "jnp instead")
+                elif isinstance(f, ast.Attribute) and \
+                        f.attr == "device_get":
+                    ctx.report(self, node,
+                               "jax.device_get inside a jitted function is "
+                               "a forced transfer; return the value instead")
+                elif isinstance(f, ast.Name) and f.id in _SYNC_BUILTINS \
+                        and len(node.args) == 1:
+                    arg = node.args[0]
+                    rn = root_name(arg)
+                    if isinstance(arg, (ast.Name, ast.Attribute,
+                                        ast.Subscript)) and \
+                            rn is not None and rn not in static_names and \
+                            not _is_static_metadata(arg):
+                        ctx.report(self, node,
+                                   f"{f.id}(...) on a potentially traced "
+                                   "value inside a jitted function "
+                                   "concretizes it; compute with jnp or "
+                                   "declare the argument static",
+                                   severity="warning")
+
+    # -- designated host hot loops --
+    def _check_hot_loop(self, ctx: ModuleContext, fn: ast.AST) -> None:
+        for loop in ast.walk(fn):
+            if not isinstance(loop, (ast.For, ast.While)):
+                continue
+            for node in ast.walk(loop):
+                if not isinstance(node, ast.Call):
+                    continue
+                f = node.func
+                if isinstance(f, ast.Attribute) and \
+                        f.attr in ("item", "block_until_ready",
+                                   "device_get"):
+                    ctx.report(self, node,
+                               f".{f.attr}() inside the {fn.name}() hot "
+                               "loop blocks the async dispatch pipeline "
+                               "every iteration; read lagged copies outside "
+                               "the loop (see obs_lagged_stats)")
+
+
+def _is_static_metadata(node: ast.AST) -> bool:
+    """``x.shape[0]`` / ``x.ndim`` / ``x.dtype`` / ``x.size`` are trace-time
+    Python values even on tracers — casting them is not a sync."""
+    for sub in ast.walk(node):
+        if isinstance(sub, ast.Attribute) and \
+                sub.attr in ("shape", "ndim", "dtype", "size"):
+            return True
+    return False
+
+
+def _has_nonconst_arg(call: ast.Call) -> bool:
+    for a in list(call.args) + [kw.value for kw in call.keywords]:
+        if not isinstance(a, ast.Constant):
+            return True
+    return False
+
+
+def _collect_jitted(ctx: ModuleContext) -> List[Tuple[ast.AST, Set[str]]]:
+    """Every function the module jits: decorated defs, defs wrapped by name
+    via ``jax.jit(f)``, and jitted lambdas."""
+    out: List[Tuple[ast.AST, Set[str]]] = []
+    defs_by_name = {}
+    for node in ast.walk(ctx.tree):
+        if isinstance(node, (ast.FunctionDef, ast.AsyncFunctionDef)):
+            defs_by_name.setdefault(node.name, []).append(node)
+    for node in ast.walk(ctx.tree):
+        if isinstance(node, (ast.FunctionDef, ast.AsyncFunctionDef)) and \
+                is_jit_decorated(node):
+            call = next((decorator_jit_call(d) for d in node.decorator_list
+                         if is_jit_expr(d) or jit_call_info(d) is not None),
+                        None)
+            out.append((node, static_names_from_call(call, node)))
+        call = jit_call_info(node)
+        if call is not None and call.args:
+            target = call.args[0]
+            if is_jit_expr(target):       # partial(jax.jit, ...) form
+                target = call.args[1] if len(call.args) > 1 else None
+            if isinstance(target, ast.Lambda):
+                out.append((target, static_names_from_call(call, target)))
+            elif isinstance(target, ast.Name):
+                for fn in defs_by_name.get(target.id, ()):
+                    out.append((fn, static_names_from_call(call, fn)))
+    return out

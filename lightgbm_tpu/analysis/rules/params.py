@@ -1,0 +1,136 @@
+"""Rule: unregistered-param — config keys read but never registered.
+
+``config.py``'s ``_PARAMS`` registry is the single source of truth for the
+parameter surface; ``tests/test_params_consumed.py`` already proves every
+REGISTERED param is consumed somewhere. This rule closes the opposite gap: a
+``params["knob"]`` / ``params.get("knob")`` / ``conf.knob`` /
+``getattr(conf, "knob")`` read whose key was never registered. Such a read
+always sees the hard-coded fallback (or raises AttributeError on a Config),
+because ``Config.update`` routes unknown user keys into ``conf.extra`` — the
+knob looks wired up but can never be set. The registry (names + every alias)
+is extracted by AST-parsing config.py, never by importing it.
+
+Config variables are recognized conservatively: names assigned from
+``params_to_config(...)`` / ``Config(...)`` / ``<conf>.copy()`` in the same
+function, and parameters annotated ``: Config``. (A bare name like ``conf``
+is NOT assumed to be a Config — efb.py uses ``conf`` for a conflict matrix.)
+"""
+from __future__ import annotations
+
+import ast
+from typing import Set
+
+from ..core import ModuleContext, Rule, register, registered_params
+
+# Config's own API surface (methods/attrs that are not params)
+_CONFIG_API = {"extra", "update", "copy", "to_dict", "str2map", "from_cli"}
+_PARAM_DICT_RECEIVERS = {"params"}
+
+
+@register
+class UnregisteredParam(Rule):
+    name = "unregistered-param"
+    severity = "error"
+    description = ("params[...]/params.get(...)/conf.<attr> key not "
+                   "declared in config.py's _PARAMS registry")
+    rationale = ("an unregistered key silently lands in conf.extra; the "
+                 "knob reads as wired but user settings never reach it")
+
+    def check_module(self, ctx: ModuleContext) -> None:
+        if ctx.relpath.endswith("lightgbm_tpu/config.py"):
+            return   # the registry itself
+        known = registered_params()
+        if not known:
+            return   # config.py unavailable (fixture runs): stay silent
+        for node in ast.walk(ctx.tree):
+            # params["key"] / params.get("key")
+            if isinstance(node, ast.Subscript) and \
+                    _is_params_dict(node.value):
+                key = node.slice
+                if isinstance(key, ast.Constant) and \
+                        isinstance(key.value, str) and key.value not in known:
+                    self._flag(ctx, node, key.value)
+            elif isinstance(node, ast.Call):
+                f = node.func
+                # NOT .pop(): its dominant in-tree use is the sklearn wrapper
+                # scrubbing estimator-level kwargs OUT of the dict before it
+                # reaches the engine — flagging that would punish the cure
+                if isinstance(f, ast.Attribute) and \
+                        f.attr in ("get", "setdefault") and \
+                        _is_params_dict(f.value) and node.args:
+                    key = node.args[0]
+                    if isinstance(key, ast.Constant) and \
+                            isinstance(key.value, str) and \
+                            key.value not in known:
+                        self._flag(ctx, node, key.value,
+                                   via=f.attr + "()")
+        for fn in ast.walk(ctx.tree):
+            if isinstance(fn, (ast.FunctionDef, ast.AsyncFunctionDef)):
+                self._check_config_vars(ctx, fn, known)
+
+    def _check_config_vars(self, ctx: ModuleContext, fn: ast.AST,
+                           known: Set[str]) -> None:
+        conf_vars = _config_vars(fn)
+        if not conf_vars:
+            return
+        for node in ast.walk(fn):
+            if isinstance(node, ast.Attribute) and \
+                    isinstance(node.value, ast.Name) and \
+                    node.value.id in conf_vars:
+                attr = node.attr
+                if attr.startswith("_") or attr in _CONFIG_API:
+                    continue
+                if attr not in known:
+                    self._flag(ctx, node, attr, via="attribute")
+            elif isinstance(node, ast.Call) and \
+                    isinstance(node.func, ast.Name) and \
+                    node.func.id == "getattr" and len(node.args) >= 2 and \
+                    isinstance(node.args[0], ast.Name) and \
+                    node.args[0].id in conf_vars and \
+                    isinstance(node.args[1], ast.Constant) and \
+                    isinstance(node.args[1].value, str):
+                attr = node.args[1].value
+                if not attr.startswith("_") and attr not in _CONFIG_API \
+                        and attr not in known:
+                    self._flag(ctx, node, attr, via="getattr")
+
+    def _flag(self, ctx: ModuleContext, node: ast.AST, key: str,
+              via: str = "subscript") -> None:
+        ctx.report(self, node,
+                   f"config key {key!r} (via {via}) is not registered in "
+                   "config.py _PARAMS (nor as an alias); register it or "
+                   "the setting silently lands in conf.extra")
+
+
+def _is_params_dict(node: ast.AST) -> bool:
+    if isinstance(node, ast.Name):
+        return node.id in _PARAM_DICT_RECEIVERS
+    return isinstance(node, ast.Attribute) and \
+        node.attr in _PARAM_DICT_RECEIVERS
+
+
+def _config_vars(fn: ast.AST) -> Set[str]:
+    out: Set[str] = set()
+    args = fn.args
+    for p in args.posonlyargs + args.args + args.kwonlyargs:
+        ann = p.annotation
+        if isinstance(ann, ast.Name) and ann.id == "Config":
+            out.add(p.arg)
+        elif isinstance(ann, ast.Constant) and ann.value == "Config":
+            out.add(p.arg)
+    for node in ast.walk(fn):
+        if not isinstance(node, ast.Assign) or \
+                not isinstance(node.value, ast.Call):
+            continue
+        f = node.value.func
+        name = f.id if isinstance(f, ast.Name) else \
+            f.attr if isinstance(f, ast.Attribute) else ""
+        from_ctor = name in ("params_to_config", "Config")
+        from_copy = (name == "copy" and isinstance(f, ast.Attribute)
+                     and isinstance(f.value, ast.Name)
+                     and f.value.id in out)
+        if from_ctor or from_copy:
+            for t in node.targets:
+                if isinstance(t, ast.Name):
+                    out.add(t.id)
+    return out

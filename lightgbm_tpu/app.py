@@ -232,10 +232,11 @@ def run_convert_model(conf: Config, params: Dict) -> None:
                   "not supported; only cpp is (matching the reference, "
                   "config.h:660)")
     from .io.model_text import model_to_cpp
+    from .utils import atomic_io
     booster = Booster(model_file=conf.input_model)
     out = conf.convert_model if conf.convert_model else "gbdt_prediction.cpp"
-    with open(out, "w") as fh:
-        fh.write(model_to_cpp(booster, booster._ensure_host_trees()))
+    atomic_io.atomic_write_text(
+        out, model_to_cpp(booster, booster._ensure_host_trees()))
     log.info(f"Finished converting model; C++ code saved to {out}")
 
 

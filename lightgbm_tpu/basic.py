@@ -35,6 +35,16 @@ from .utils import log
 from .io import model_text
 
 
+# accumulate rows into ONE preallocated device buffer via a donated
+# dynamic-update (peak device memory 1x + one chunk; a jnp.concatenate of all
+# chunks at the end would transiently hold 2x). Module-level so the jit
+# wrapper (and its trace cache) is shared across Dataset constructions
+# instead of being rebuilt — and retraced — per call.
+_set_rows = jax.jit(
+    lambda acc, chunk, s0: jax.lax.dynamic_update_slice(acc, chunk, (s0, 0)),
+    donate_argnums=0)
+
+
 def _is_scipy_sparse(data) -> bool:
     try:
         import scipy.sparse as sps
@@ -382,13 +392,6 @@ class Dataset:
         _mark("efb_plan_s")
 
         from .efb import apply_bundles
-        # accumulate into ONE preallocated device buffer via a donated
-        # dynamic-update (peak device memory 1x + one chunk; a
-        # jnp.concatenate of all chunks at the end would transiently hold 2x)
-        set_rows = jax.jit(
-            lambda acc, chunk, s0: jax.lax.dynamic_update_slice(
-                acc, chunk, (s0, 0)),
-            donate_argnums=0)
         state = {"acc": None, "upload_s": 0.0, "exc": None}
         q: "_queue.Queue" = _queue.Queue(maxsize=2)
 
@@ -405,8 +408,8 @@ class Dataset:
                     dev = jax.device_put(cb)
                     if state["acc"] is None:
                         state["acc"] = jnp.zeros((n, cb.shape[1]), cb.dtype)
-                    state["acc"] = set_rows(state["acc"], dev,
-                                            jnp.int32(s0))
+                    state["acc"] = _set_rows(state["acc"], dev,
+                                             jnp.int32(s0))
                     # block: upload_s must measure transfer completion, not
                     # async enqueue, or the phase report under-counts it
                     state["acc"].block_until_ready()

@@ -89,9 +89,14 @@ def plan_bundles(bins: np.ndarray, mappers: List[BinMapper],
                   else rng.choice(n, sample_cnt, replace=False))
     sub = bins[sample_idx]
 
-    # per-feature bin histograms over the (global when reduced) sample
+    # per-feature bin histograms over the (global when reduced) sample.
     maxb = max((m.num_bins for m in mappers), default=1)
-    counts = np.zeros((f, maxb), dtype=np.float64)
+    # float64 is REQUIRED here and below: these are exact integer row counts
+    # (representable to 2^53) that every rank must agree on bit-for-bit for
+    # the greedy bundling plan to be deterministic across processes; they
+    # stay host-side — only the f32 nonzero mask is ever uploaded.
+    counts = np.zeros((f, maxb),   # tpu-lint: disable=dtype-drift
+                      dtype=np.float64)
     for j, m in enumerate(mappers):
         bc = np.bincount(sub[:, j], minlength=maxb)
         counts[j] = bc[:maxb]
@@ -121,12 +126,19 @@ def plan_bundles(bins: np.ndarray, mappers: List[BinMapper],
     # [50k, 4228] f32 mask would be a ~845MB transient at Allstate width)
     cj = [j for j, _ in cand]
     import jax.numpy as jnp
-    conf = np.zeros((len(cj), len(cj)), dtype=np.float64)
+    # f64 conflict accumulator: same exactness requirement as `counts` —
+    # chunk sums must be order-independent integers for cross-rank
+    # reproducibility; the device contraction itself runs in f32 (each chunk
+    # count is <= 8192, exactly representable), only the host-side running
+    # sum needs the f64 headroom
+    conf = np.zeros((len(cj), len(cj)),   # tpu-lint: disable=dtype-drift
+                    dtype=np.float64)
     db_c = default_bin[cj][None, :]
     for s0 in range(0, sub.shape[0], 8192):
         nz = (sub[s0: s0 + 8192, cj] != db_c).astype(np.float32)
         nz_dev = jnp.asarray(nz)
-        conf += np.asarray(nz_dev.T @ nz_dev, dtype=np.float64)
+        conf += np.asarray(nz_dev.T @ nz_dev,   # tpu-lint: disable=dtype-drift
+                           dtype=np.float64)
     if reduce_fn is not None:
         conf = reduce_fn(conf)
     cidx = {j: k for k, j in enumerate(cj)}

@@ -163,11 +163,16 @@ def _auc_mu(label, prob, w, weights_matrix=None):
     reference. All k(k-1)/2 pairs run in ONE lax.map dispatch instead of
     k^2 python-level AUC calls (VERDICT r3 weak #8)."""
     k = prob.shape[1]
+    # the class-weight matrix and its pair differences are tiny [k, k] host
+    # values kept in f64 to match the reference's double math exactly
+    # (config.cpp:157-161); the downcast happens once at the lax.map upload
+    # where f32 is the intended comparison precision
     A = (np.ones((k, k)) - np.eye(k) if weights_matrix is None
-         else np.asarray(weights_matrix, np.float64).reshape(k, k))
+         else np.asarray(weights_matrix,   # tpu-lint: disable=dtype-drift
+                         np.float64).reshape(k, k))
     pairs = [(a, b) for a in range(k) for b in range(a + 1, k)]
     v = np.stack([A[a] - A[b] for a, b in pairs])              # [P, k]
-    t1 = np.asarray([v[p][a] - v[p][b]
+    t1 = np.asarray([v[p][a] - v[p][b]   # tpu-lint: disable=dtype-drift
                      for p, (a, b) in enumerate(pairs)], np.float64)
     lab = label.astype(jnp.int32)
 

@@ -820,7 +820,9 @@ class GBDT:
                              lids)
             return trees, new_score, cegb_st, ok
 
-        return jax.jit(step)
+        # built once per (config, schema) by the caller, which caches the
+        # wrapper on the instance — not a per-call rebuild
+        return jax.jit(step)   # tpu-lint: disable=retrace-hazard
 
     def _apply_tree_delta(self, score, delta, cls, titer):
         """Fold one finished class tree's per-row delta into the score.
@@ -879,7 +881,9 @@ class GBDT:
                     return tuple(
                         (jax.tree.map(lambda a, i=i: a[i], st), li[i])
                         for i in range(k))
-                unst = self._unstack_fn = jax.jit(_unstack)
+                # lazily built ONCE and cached on the instance; later calls
+                # reuse the wrapper, so its trace cache persists
+                unst = self._unstack_fn = jax.jit(_unstack)   # tpu-lint: disable=retrace-hazard
             trees = list(unst(stacked, lids))
         return trees, new_score, cegb_out, ok
 
@@ -1421,7 +1425,11 @@ class GBDT:
             "fingerprint": self._resume_fingerprint(),
         }
         arrays["train_score"] = np.asarray(self.train_score)
-        arrays["init_scores"] = np.asarray(self.init_scores, dtype=np.float64)
+        # snapshot state is serialized in f64 on purpose: resume must be
+        # bit-lossless for host-side quantities (init scores, RNG gauss
+        # carry), and these arrays go to disk, never to the device
+        arrays["init_scores"] = np.asarray(   # tpu-lint: disable=dtype-drift
+            self.init_scores, dtype=np.float64)
         arrays["bag_key"] = np.asarray(self._bag_key)
         if self._bag_mask is not None:
             arrays["bag_mask"] = np.asarray(self._bag_mask)
@@ -1432,8 +1440,8 @@ class GBDT:
                 arrays[f"rng{nm}_keys"] = np.asarray(st[1], dtype=np.uint32)
                 arrays[f"rng{nm}_pos"] = np.asarray([st[2], st[3]],
                                                     dtype=np.int64)
-                arrays[f"rng{nm}_gauss"] = np.asarray([st[4]],
-                                                      dtype=np.float64)
+                arrays[f"rng{nm}_gauss"] = np.asarray(   # tpu-lint: disable=dtype-drift
+                    [st[4]], dtype=np.float64)
         if self.models_dev:
             # ONE batched device_get, then per-field stacking (same rationale
             # as finalize: per-field readbacks cost a tunnel round-trip each)
@@ -1467,8 +1475,9 @@ class GBDT:
         self.iter_ = int(meta["iter"])
         self.learning_rate = float(meta["learning_rate"])
         self._has_init_score = bool(meta["has_init_score"])
-        self.init_scores = np.asarray(arrays["init_scores"],
-                                      dtype=np.float64)
+        # f64 for the same losslessness reason as get_resume_state; stays host
+        self.init_scores = np.asarray(   # tpu-lint: disable=dtype-drift
+            arrays["init_scores"], dtype=np.float64)
         self.train_score = jnp.asarray(arrays["train_score"])
         self._bag_key = jnp.asarray(arrays["bag_key"])
         self._bag_mask = (jnp.asarray(arrays["bag_mask"])

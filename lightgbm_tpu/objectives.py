@@ -449,10 +449,15 @@ class LambdaRank(ObjectiveFunction):
         self.norm = self.config.lambdarank_norm
         # inverse max DCG per query
         lab_grid = np.where(msk, label_np[idx], -1)
-        inv_max_dcg = np.zeros(self.num_queries, dtype=np.float64)
+        # ideal-DCG normalizers are computed host-side in f64 (matching the
+        # reference's double accumulation, rank_objective.hpp) and cast to
+        # f32 explicitly at the jnp.asarray upload below
+        inv_max_dcg = np.zeros(self.num_queries,   # tpu-lint: disable=dtype-drift
+                               dtype=np.float64)
         for q in range(self.num_queries):
             ls = np.sort(lab_grid[q][msk[q]])[::-1]
-            g = np.array([gains[int(v)] for v in ls], dtype=np.float64)
+            g = np.array([gains[int(v)] for v in ls],   # tpu-lint: disable=dtype-drift
+                         dtype=np.float64)
             disc = 1.0 / np.log2(np.arange(len(ls)) + 2.0)
             dcg = float((g * disc).sum())
             inv_max_dcg[q] = 1.0 / dcg if dcg > 0 else 0.0

@@ -62,12 +62,16 @@ EVENT_SCHEMAS: Dict[str, Tuple[Dict[str, Any], Dict[str, Any]]] = {
 }
 
 
+_schema_lock = threading.Lock()
+
+
 def register_event(name: str, required: Dict[str, Any],
                    optional: Optional[Dict[str, Any]] = None) -> None:
     """Register an event type (extension point for out-of-tree consumers)."""
-    if name in EVENT_SCHEMAS:
-        raise ValueError(f"event type {name!r} already registered")
-    EVENT_SCHEMAS[name] = (dict(required), dict(optional or {}))
+    with _schema_lock:
+        if name in EVENT_SCHEMAS:
+            raise ValueError(f"event type {name!r} already registered")
+        EVENT_SCHEMAS[name] = (dict(required), dict(optional or {}))
 
 
 def _validate(etype: str, fields: Dict[str, Any]) -> None:

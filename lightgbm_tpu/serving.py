@@ -185,7 +185,11 @@ class PredictEngine:
         def producer():
             try:
                 for i in range(0, n, c):
-                    xb = np.asarray(x[i: i + c], dtype=np.float64)
+                    # f64 by design (see docstring): bin-boundary comparisons
+                    # run host-side at full precision; only the uint8 binned
+                    # matrix is uploaded
+                    xb = np.asarray(x[i: i + c],   # tpu-lint: disable=dtype-drift
+                                    dtype=np.float64)
                     bins = self.router.bin_matrix(xb)
                     m = bins.shape[0]
                     if m < c:

@@ -1,11 +1,16 @@
-"""Smoke-check the three nonfinite_policy behaviors end to end.
+"""Nonfinite-policy smoke check — thin shim over the tpu-lint dynamic rule.
 
-Trains a tiny model under each policy with a custom objective that turns
-non-finite mid-run, and verifies:
+The real logic now lives in ``lightgbm_tpu.analysis.rules.nonfinite``
+(rule name ``nonfinite-policy-smoke``): train a tiny model under each of the
+three policies with an objective that turns NaN mid-run and verify
 
     fatal          -> LightGBMError raised, training aborted
     warn_skip_tree -> training completes; poisoned iterations grow no trees
     clip           -> training completes with all trees; finite predictions
+
+It is a *dynamic* rule (imports the package, and therefore JAX), so the
+plain ``python -m lightgbm_tpu.analysis`` AST pass never runs it — this
+script and ``--dynamic`` do.
 
 Usage:
     JAX_PLATFORMS=cpu python scripts/check_nonfinite_policy.py
@@ -18,81 +23,18 @@ import sys
 sys.path.insert(0, os.path.dirname(os.path.dirname(os.path.abspath(__file__))))
 os.environ.setdefault("JAX_PLATFORMS", "cpu")
 
-import numpy as np  # noqa: E402
 
-import lightgbm_tpu as lgb  # noqa: E402
-from lightgbm_tpu.utils import log  # noqa: E402
-
-ROUNDS = 5
-NAN_FROM = 3     # fobj call number at which gradients turn NaN
-NAN_ROWS = 5     # how many rows get poisoned (partial: clip can continue)
-
-
-def make_fobj():
-    state = {"n": 0}
-
-    def fobj(preds, ds):
-        state["n"] += 1
-        y = np.asarray(ds.label, dtype=np.float64)
-        g = np.asarray(preds, dtype=np.float64) - y
-        h = np.ones_like(g)
-        if state["n"] >= NAN_FROM:
-            g[:NAN_ROWS] = np.nan
-        return g, h
-
-    return fobj
-
-
-def run_policy(policy, X, y):
-    params = {"verbosity": -1, "num_leaves": 7, "min_data_in_leaf": 5,
-              "objective": "none", "nonfinite_policy": policy}
-    return lgb.train(params, lgb.Dataset(X, label=y),
-                     num_boost_round=ROUNDS, fobj=make_fobj())
-
-
-def main():
-    rng = np.random.RandomState(0)
-    X = rng.rand(400, 6)
-    y = X @ rng.rand(6) + 0.1 * rng.randn(400)
-    failures = []
-
-    # fatal: must abort with LightGBMError
-    try:
-        run_policy("fatal", X, y)
-        failures.append("fatal: training completed (expected LightGBMError)")
-    except log.LightGBMError:
-        print("PASS fatal: aborted with LightGBMError")
-
-    # warn_skip_tree: completes, poisoned iterations grow no trees
-    try:
-        bst = run_policy("warn_skip_tree", X, y)
-        if bst.num_trees() == NAN_FROM - 1:
-            print(f"PASS warn_skip_tree: kept {bst.num_trees()}/{ROUNDS} "
-                  "trees (poisoned iterations skipped)")
-        else:
-            failures.append(f"warn_skip_tree: {bst.num_trees()} trees, "
-                            f"expected {NAN_FROM - 1}")
-    except Exception as e:
-        failures.append(f"warn_skip_tree: raised {type(e).__name__}: {e}")
-
-    # clip: completes with every tree and finite predictions
-    try:
-        bst = run_policy("clip", X, y)
-        pred = bst.predict(X)
-        if bst.num_trees() != ROUNDS:
-            failures.append(f"clip: {bst.num_trees()} trees, "
-                            f"expected {ROUNDS}")
-        elif not np.isfinite(pred).all():
-            failures.append("clip: non-finite predictions")
-        else:
-            print(f"PASS clip: {ROUNDS} trees, finite predictions")
-    except Exception as e:
-        failures.append(f"clip: raised {type(e).__name__}: {e}")
-
+def main() -> int:
+    from lightgbm_tpu.analysis import all_rules
+    rule = all_rules()["nonfinite-policy-smoke"]
+    failures = rule.run_dynamic()
     for f in failures:
-        print(f"FAIL {f}")
-    sys.exit(1 if failures else 0)
+        print(f"FAIL {f.message}")
+    if not failures:
+        print("PASS nonfinite policies: fatal aborts, warn_skip_tree skips "
+              "poisoned trees, clip stays finite")
+    return 1 if failures else 0
 
 
 if __name__ == "__main__":
-    main()
+    sys.exit(main())

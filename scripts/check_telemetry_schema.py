@@ -1,116 +1,34 @@
-"""Static telemetry-schema check over every ``emit(...)`` call site.
+"""Telemetry-schema check — thin shim over the tpu-lint rule.
 
-Walks the ASTs of all modules under ``lightgbm_tpu/`` and verifies that each
-``obs.emit`` / ``emit`` / ``EVENTS.emit`` call:
-
-- names its event type with a string LITERAL (dynamic types defeat both this
-  check and grep-ability),
-- uses an event type registered in ``obs.events.EVENT_SCHEMAS``,
-- passes every REQUIRED field of that type as a keyword argument,
-- passes no keyword that is neither required nor optional for the type.
-
-This is the static complement of the runtime validation in
-``obs.events.emit`` (which raises on violations): the runtime check catches
-what executes, this catches every call site that *could* execute — including
-rarely-hit paths like fault injection and distributed retries. Runs as a fast
-tier-1 test (tests/test_observability.py invokes main()).
+The real logic now lives in ``lightgbm_tpu.analysis.rules.telemetry``
+(rule name ``telemetry-schema``): every ``emit(...)`` call site must use a
+literal, registered event type and pass exactly the registered fields. See
+docs/STATIC_ANALYSIS.md. This wrapper keeps the historical entry point (and
+the ``main() -> 0`` contract tests/test_observability.py asserts) alive.
 
 Usage:
     python scripts/check_telemetry_schema.py
 
 Exits non-zero listing each violating call site.
 """
-import ast
 import os
 import sys
 
 REPO = os.path.dirname(os.path.dirname(os.path.abspath(__file__)))
 sys.path.insert(0, REPO)
-os.environ.setdefault("JAX_PLATFORMS", "cpu")
-
-PKG_DIR = os.path.join(REPO, "lightgbm_tpu")
-
-
-def _is_emit_call(node: ast.Call):
-    """Match ``emit(...)``, ``obs.emit(...)``, ``events.emit(...)``,
-    ``EVENTS.emit(...)``, ``self.emit(...)`` is NOT matched (no such idiom
-    in-tree). Returns True for anything whose terminal attr/name is 'emit'."""
-    f = node.func
-    if isinstance(f, ast.Name):
-        return f.id == "emit"
-    if isinstance(f, ast.Attribute):
-        return f.attr == "emit"
-    return False
-
-
-def check_file(path: str, schemas) -> list:
-    with open(path) as fh:
-        src = fh.read()
-    try:
-        tree = ast.parse(src, filename=path)
-    except SyntaxError as e:
-        return [f"{path}: does not parse: {e}"]
-    rel = os.path.relpath(path, REPO)
-    problems = []
-    for node in ast.walk(tree):
-        if not isinstance(node, ast.Call) or not _is_emit_call(node):
-            continue
-        where = f"{rel}:{node.lineno}"
-        if not node.args:
-            problems.append(f"{where}: emit() without an event type")
-            continue
-        etype_node = node.args[0]
-        if not (isinstance(etype_node, ast.Constant)
-                and isinstance(etype_node.value, str)):
-            problems.append(f"{where}: event type must be a string literal")
-            continue
-        etype = etype_node.value
-        if etype not in schemas:
-            problems.append(f"{where}: unregistered event type {etype!r}")
-            continue
-        required, optional = schemas[etype]
-        kw_names = set()
-        dynamic_kwargs = False
-        for kw in node.keywords:
-            if kw.arg is None:       # **fields — cannot check statically
-                dynamic_kwargs = True
-            else:
-                kw_names.add(kw.arg)
-        for name in required:
-            if name not in kw_names and not dynamic_kwargs:
-                problems.append(f"{where}: event {etype!r} missing required "
-                                f"field {name!r}")
-        for name in kw_names:
-            if name not in required and name not in optional:
-                problems.append(f"{where}: event {etype!r} passes "
-                                f"unregistered field {name!r}")
-    return problems
 
 
 def main() -> int:
-    from lightgbm_tpu.obs.events import EVENT_SCHEMAS
-    problems = []
-    n_files = 0
-    n_sites = 0
-    for root, _dirs, files in os.walk(PKG_DIR):
-        # the obs package itself holds the emit/validate plumbing (delegating
-        # wrappers with a non-literal etype), not telemetry call sites
-        if os.path.basename(root) == "obs":
-            continue
-        for fn in sorted(files):
-            if not fn.endswith(".py"):
-                continue
-            path = os.path.join(root, fn)
-            n_files += 1
-            with open(path) as fh:
-                n_sites += fh.read().count("emit(")
-            problems.extend(check_file(path, EVENT_SCHEMAS))
+    from lightgbm_tpu.analysis import analyze_paths, event_schemas
+    res = analyze_paths(paths=("lightgbm_tpu",), rules=("telemetry-schema",),
+                        baseline_path=None)
+    problems = res.parse_errors + res.findings
     if problems:
-        for p in problems:
-            print(f"FAIL {p}")
+        for f in problems:
+            print(f"FAIL {f.render()}")
         return 1
-    print(f"PASS telemetry schema: {n_files} modules, ~{n_sites} emit sites, "
-          f"{len(EVENT_SCHEMAS)} registered event types, 0 violations")
+    print(f"PASS telemetry schema: {res.files} modules, "
+          f"{len(event_schemas())} registered event types, 0 violations")
     return 0
 
 

@@ -34,6 +34,13 @@ sys.path.insert(0, os.path.dirname(os.path.dirname(os.path.abspath(__file__))))
 REPO = os.path.dirname(os.path.dirname(os.path.abspath(__file__)))
 
 
+def write_results(path, doc):
+    """The recorded-numbers JSON is a committed artifact other runs assert
+    against; write it atomically so an interrupted bench never truncates it."""
+    from lightgbm_tpu.utils import atomic_io
+    atomic_io.atomic_write_text(path, json.dumps(doc, indent=1) + "\n")
+
+
 def synth_higgs(n_rows, n_feat=28, seed=0):
     sys.path.insert(0, REPO)
     from bench import synth_higgs as sh
@@ -162,8 +169,7 @@ def run_ranking(args):
         out["entries"] = [e for e in out["entries"]
                           if not all(e.get(k) == v for k, v in key.items())]
         out["entries"].append(entry)
-        with open(args.out, "w") as fh:
-            json.dump(out, fh, indent=1)
+        write_results(args.out, out)
         print(f"reference: valid NDCG@10={entry['ref_valid_ndcg10']} "
               f"time={ref_time:.1f}s", file=sys.stderr)
 
@@ -209,8 +215,7 @@ def run_ranking(args):
               file=sys.stderr)
         assert delta < 0.005, f"NDCG parity FAILED: {delta:.6f} >= 0.005"
 
-    with open(args.out, "w") as fh:
-        json.dump(out, fh, indent=1)
+    write_results(args.out, out)
     print(json.dumps(out.get("ranking_parity") or entry))
 
 
@@ -230,7 +235,8 @@ def train_reference(cli, workdir, train_path, valid_path, leaves, bins, iters,
     ]
     if threads:
         lines.append(f"num_threads={threads}")
-    with open(conf, "w") as fh:
+    # transient conf in the workdir tempdir, consumed by the subprocess below
+    with open(conf, "w") as fh:   # tpu-lint: disable=non-atomic-artifact-write
         fh.write("\n".join(lines) + "\n")
     t0 = time.time()
     subprocess.run([cli, f"config={conf}"], check=True, cwd=workdir,
@@ -241,7 +247,8 @@ def train_reference(cli, workdir, train_path, valid_path, leaves, bins, iters,
     for tag in predict_on:
         pconf = os.path.join(workdir, f"ref_pred_{tag}.conf")
         out = os.path.join(workdir, f"ref_pred_{tag}.txt")
-        with open(pconf, "w") as fh:
+        # same: transient predict conf for the reference CLI subprocess
+        with open(pconf, "w") as fh:   # tpu-lint: disable=non-atomic-artifact-write
             fh.write("\n".join([
                 "task=predict", f"data={paths[tag]}", f"input_model={model}",
                 f"output_result={out}",
@@ -349,8 +356,7 @@ def main():
         print(f"reference: train_auc={entry['ref_train_auc']} "
               f"valid_auc={entry['ref_valid_auc']} time={ref_time:.1f}s",
               file=sys.stderr)
-        with open(args.out, "w") as fh:   # persist before the TPU phase
-            json.dump(out, fh, indent=1)
+        write_results(args.out, out)   # persist before the TPU phase
 
     if not args.skip_tpu:
         if entry is None:
@@ -386,8 +392,7 @@ def main():
               f"|delta_valid|={delta:.6f}", file=sys.stderr)
         assert delta < 0.005, f"AUC parity FAILED: |delta|={delta:.6f} >= 0.005"
 
-    with open(args.out, "w") as fh:
-        json.dump(out, fh, indent=1)
+    write_results(args.out, out)
     print(json.dumps(out.get("parity") or out["entries"][-1]))
 
 

@@ -1,4 +1,7 @@
 """Marginal device cost per depthwise level: grow at max_depth k for several k."""
+# profiling harness: building jit wrappers per invocation is the POINT
+# (each run measures a fresh compile/dispatch pair)
+# tpu-lint: disable-file=retrace-hazard
 import sys
 sys.path.insert(0, "/root/repo")
 import time

@@ -3,6 +3,9 @@
 Times op(x) repeated K times inside one jitted fori_loop; device time per op =
 (t_K - t_1) / (K - 1).
 """
+# profiling harness: building jit wrappers per invocation is the POINT
+# (each run measures a fresh compile/dispatch pair)
+# tpu-lint: disable-file=retrace-hazard
 import sys
 sys.path.insert(0, "/root/repo")
 import time

@@ -1,5 +1,8 @@
 """Feasibility probe for segment-packed levels: cost of the [F, N] bin-matrix
 gather along N (packed reorder) and 1-D channel gathers at 10M rows."""
+# profiling harness: building jit wrappers per invocation is the POINT
+# (each run measures a fresh compile/dispatch pair)
+# tpu-lint: disable-file=retrace-hazard
 import sys
 sys.path.insert(0, "/root/repo")
 import functools, time

@@ -5,6 +5,9 @@ bookkeeping, split search, tree-array scatters) that does not shrink with
 rows. Then break b down: grower alone vs grower+gradients+score, and glue
 scaling with num_leaves (level count).
 """
+# profiling harness: building jit wrappers per invocation is the POINT
+# (each run measures a fresh compile/dispatch pair)
+# tpu-lint: disable-file=retrace-hazard
 import sys, time
 sys.path.insert(0, "/root/repo")
 import numpy as np

@@ -4,6 +4,9 @@ Timing methodology: K repetitions inside ONE jit (fori_loop), cost =
 (t_K - t_1) / (K - 1) — the tunneled runtime's ~100 ms dispatch latency
 cancels out (same subtraction bench.py's phase breakdown uses).
 """
+# profiling harness: building jit wrappers per invocation is the POINT
+# (each run measures a fresh compile/dispatch pair)
+# tpu-lint: disable-file=retrace-hazard
 import sys
 sys.path.insert(0, "/root/repo")
 import functools

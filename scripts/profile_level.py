@@ -1,5 +1,8 @@
 """Isolate one depthwise level() call (with bookkeeping) vs its hist_routed core,
 and test whether the [L,F,B,3] minor-dim-3 state layout is the bottleneck."""
+# profiling harness: building jit wrappers per invocation is the POINT
+# (each run measures a fresh compile/dispatch pair)
+# tpu-lint: disable-file=retrace-hazard
 import sys
 sys.path.insert(0, "/root/repo")
 import time
