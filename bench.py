@@ -38,6 +38,51 @@ def synth_higgs(n_rows: int, n_feat: int = 28, seed: int = 0):
     return X, y
 
 
+def _compile_split(booster, t_compile):
+    """Cold/warm compile split, sourced from obs compile events rather than
+    wall-clock guessing (the old single compile_s conflated XLA compilation
+    with the first iteration's device time).
+
+    - ``compile_cold_s``: the background AOT compile of the fused step
+      (prewarm.py emits compile/what=fused_step_aot/key=cold), falling back
+      to the warmup wall time when the prewarm was skipped or missed.
+    - ``compile_warm_s``: the SAME program lowered+compiled again now that
+      XLA's in-process caches are hot — the floor a persistent compilation
+      cache could reach.
+    - hit/miss counts: prewarm adoptions vs compiles that still happened at
+      dispatch (compile/what=fused_step events from _obs_track_compiles).
+    """
+    from lightgbm_tpu import obs, prewarm
+    gb = booster._gbdt
+    try:
+        prewarm.aot_compile_step(gb, tag="warm")
+    except Exception as e:   # the split is reporting, never a bench failure
+        print(f"# warm recompile measurement failed: {e}", file=sys.stderr)
+    ev = obs.EVENTS.snapshot()
+    aot = {e.get("key"): e for e in ev if e["type"] == "compile"
+           and e.get("what") == "fused_step_aot"}
+    dispatch_compiles = sum(1 for e in ev if e["type"] == "compile"
+                            and e.get("what") == "fused_step")
+    adopted = any(e["type"] == "aot_prewarm" and e.get("phase") == "adopted"
+                  for e in ev)
+    cold = aot.get("cold")
+    out = {
+        "compile_cold_s": round(cold["duration_s"], 2) if cold
+        else round(t_compile, 2),
+        "prewarm_hit": adopted,
+        "dispatch_compiles": dispatch_compiles,
+    }
+    warm = aot.get("warm")
+    if warm:
+        out["compile_warm_s"] = round(warm["duration_s"], 2)
+    barrier = next((e.get("duration_s") for e in ev
+                    if e["type"] == "aot_prewarm"
+                    and e.get("phase") == "adopted"), None)
+    if barrier is not None:
+        out["prewarm_barrier_s"] = round(barrier, 2)
+    return out
+
+
 def _telemetry_snapshot():
     """Phase timings + device-memory watermark for the BENCH json (the obs
     subsystem's bench surface; empty-ish on CPU where memory_stats() is None)."""
@@ -89,6 +134,13 @@ def main():
 
     import jax
     import lightgbm_tpu as lgb
+    from lightgbm_tpu import obs
+
+    # the bench always runs with telemetry on: the cold/warm compile split
+    # and the prewarm hit/miss accounting below are sourced from the obs
+    # compile/aot_prewarm events, not from wall-clock guessing
+    obs.configure(enabled=True)
+    obs.reset()
 
     t0 = time.time()
     X, y = synth_higgs(n_rows)
@@ -121,6 +173,7 @@ def main():
     jax.block_until_ready(booster.raw_train_score())
     dt = time.time() - t0
     iters_per_sec = n_iters / dt
+    compile_split = _compile_split(booster, t_compile)
 
     # quality assert tied to the reference CLI's AUC on the SAME data
     # (VERDICT r3 weak #2: the old 0.75 floor would pass a badly-broken gain
@@ -138,7 +191,8 @@ def main():
                       f"{n_rows // 1_000_000}m_l{num_leaves}_b{max_bin}",
             "value": round(iters_per_sec, 4), "unit": "iters/sec",
             "vs_baseline": round(iters_per_sec / baseline_here, 4),
-            "bin_s": round(t_bin, 2), "compile_s": round(t_compile, 2),
+            "bin_s": round(t_bin, 2), "bin_phases": ds.construct_phases,
+            "compile_s": round(t_compile, 2), **compile_split,
             "telemetry": _telemetry_snapshot()}))
         return
     prob = 1.0 / (1.0 + np.exp(-np.asarray(booster.raw_train_score())))
@@ -180,13 +234,19 @@ def main():
     rows_tag = (f"{n_rows // 1_000_000}m" if n_rows % 1_000_000 == 0
                 else f"{n_rows // 1000}k")
     result = {
-        "metric": f"boosting_iters_per_sec_higgs{rows_tag}_l255_b63",
+        "metric": f"boosting_iters_per_sec_higgs{rows_tag}"
+                  f"_l{num_leaves}_b{max_bin}",
         "value": round(iters_per_sec, 4),
         "unit": "iters/sec",
         "vs_baseline": round(iters_per_sec / baseline_here, 4),
         "bin_s": round(t_bin, 2),
+        # disjoint wall segments (find_bins/efb_plan/stream/device_put sum to
+        # ~bin_s) + the nested stream_busy per-stage breakdown and the
+        # realized overlap_efficiency ratio — stage busy times deliberately
+        # exceed the stream_s wall when the pipeline overlaps
         "bin_phases": ds.construct_phases,
-        "compile_s": round(t_compile, 2),
+        "compile_s": round(t_compile, 2),   # warmup wall: first update + barrier
+        **compile_split,
         "train_auc": round(auc, 4),
         **({"ref_auc": round(ref_auc, 4)} if ref_auc is not None else {}),
         "telemetry": _telemetry_snapshot(),

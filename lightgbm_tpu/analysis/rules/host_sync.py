@@ -12,9 +12,12 @@ flags host-materializing calls; arguments rooted at ``static_argnames`` /
 legitimate).
 
 It also audits the designated host-side hot loops (``engine.train``'s
-boosting loop) for per-iteration syncs: ``.item()``, ``block_until_ready``,
-``device_get`` in that loop stall the async dispatch pipeline the lagged
-telemetry design exists to protect.
+boosting loop and the ingest pipeline's H2D/commit stage loops) for
+per-iteration syncs: ``.item()``, ``block_until_ready``, ``device_get`` in
+those loops stall the async dispatch pipeline the lagged telemetry design
+exists to protect — except where a sync IS the design (measured transfer
+completion, donation backpressure), which must say so in an inline
+suppression.
 """
 from __future__ import annotations
 
@@ -29,8 +32,16 @@ from ..core import (ModuleContext, Rule, decorator_jit_call, is_jit_decorated,
 _SYNC_METHODS = {"item", "tolist", "block_until_ready"}
 # builtin casts that concretize a traced value
 _SYNC_BUILTINS = {"float", "int", "bool"}
-# host-side loops audited for per-iteration syncs: (path, function name)
-HOT_LOOPS: Set[Tuple[str, str]] = {("lightgbm_tpu/engine.py", "train")}
+# host-side loops audited for per-iteration syncs: (path, function name).
+# The ingest pipeline's uploader/committer loops are in scope: their
+# block_until_ready calls are deliberate (measured transfer / backpressure)
+# and carry inline suppressions with that justification — anything NEW there
+# must justify itself the same way.
+HOT_LOOPS: Set[Tuple[str, str]] = {
+    ("lightgbm_tpu/engine.py", "train"),
+    ("lightgbm_tpu/ingest.py", "_h2d_loop"),
+    ("lightgbm_tpu/ingest.py", "_commit_loop"),
+}
 
 
 @register

@@ -1,14 +1,16 @@
 """Rule: unlocked-shared-state — cross-thread mutation without a lock.
 
-The serving engine and the observability sinks are the two places this
-codebase is deliberately multi-threaded (prediction workers; the background
-metrics flusher), so they are the two places a module-level mutable — a
+The serving engine, the observability sinks, and the chunked ingest
+pipeline are the places this codebase is deliberately multi-threaded
+(prediction workers; the background metrics flusher; the encode/H2D/commit
+stage threads), so they are the places a module-level mutable — a
 cache dict, a ``global`` rebind — can be mutated by one thread while another
 reads it. CPython's GIL makes single bytecodes atomic but NOT compound
 check-then-act sequences; the classic symptom is a shape-bucket cache that
 intermittently serves a half-built entry.
 
-Scope is intentionally narrow (``serving.py`` and ``obs/``): elsewhere,
+Scope is intentionally narrow (``serving.py``, ``ingest.py``, ``obs/``):
+elsewhere,
 module-level mutation is the normal single-threaded idiom and flagging it
 would be noise. Within scope, the rule flags
 
@@ -30,7 +32,10 @@ from typing import Set
 
 from ..core import ModuleContext, Rule, register, root_name
 
-_SCOPES = ("lightgbm_tpu/serving.py", "lightgbm_tpu/obs/")
+# exact file paths / directory prefixes that are deliberately multi-threaded:
+# the serving engine, the obs sinks, and the chunked ingest pipeline
+_SCOPE_FILES = ("lightgbm_tpu/serving.py", "lightgbm_tpu/ingest.py")
+_SCOPE_DIRS = ("lightgbm_tpu/obs/",)
 _MUTATING_METHODS = {"append", "extend", "add", "update", "setdefault",
                      "pop", "popitem", "clear", "remove", "insert",
                      "discard", "appendleft"}
@@ -48,8 +53,8 @@ class UnlockedSharedState(Rule):
                  "mutations race and intermittently corrupt caches")
 
     def check_module(self, ctx: ModuleContext) -> None:
-        if not (ctx.relpath.startswith(_SCOPES[1])
-                or ctx.relpath == _SCOPES[0]
+        if not (ctx.relpath in _SCOPE_FILES
+                or ctx.relpath.startswith(_SCOPE_DIRS)
                 or ctx.relpath.startswith("<")):   # fixtures stay in scope
             return
         shared = _module_level_mutables(ctx.tree)

@@ -35,16 +35,6 @@ from .utils import log
 from .io import model_text
 
 
-# accumulate rows into ONE preallocated device buffer via a donated
-# dynamic-update (peak device memory 1x + one chunk; a jnp.concatenate of all
-# chunks at the end would transiently hold 2x). Module-level so the jit
-# wrapper (and its trace cache) is shared across Dataset constructions
-# instead of being rebuilt — and retraced — per call.
-_set_rows = jax.jit(
-    lambda acc, chunk, s0: jax.lax.dynamic_update_slice(acc, chunk, (s0, 0)),
-    donate_argnums=0)
-
-
 def _is_scipy_sparse(data) -> bool:
     try:
         import scipy.sparse as sps
@@ -139,6 +129,8 @@ class Dataset:
         self._names: List[str] = []
         self._num_data = None
         self._num_features_raw = None
+        self._num_features_used = None  # F_b, known once metadata publishes
+        self._prewarm = None            # background AOT compile handle
         if data is not None:
             arr_shape = (data.shape if hasattr(data, "shape")
                          else np.asarray(data).shape)
@@ -237,7 +229,6 @@ class Dataset:
             seed=conf.data_random_seed, forced_bins=forced_bins,
             max_bin_by_feature=conf.max_bin_by_feature)
         distributed = False
-        bins_dev = stream_meta = None
         if sparse_in:
             if conf.num_machines > 1:
                 from .parallel.mesh import init_distributed
@@ -253,29 +244,9 @@ class Dataset:
             _mark("find_bins_s")
             binned = bin_data_sparse(raw, mappers)
             _mark("encode_s")
-        else:
-            if conf.num_machines > 1:
-                from .parallel.mesh import init_distributed
-                init_distributed(conf)
-                distributed = jax.process_count() > 1
-            if distributed:
-                # distributed bin finding: feature slices per rank + mapper
-                # allgather — identical mappers on every rank by construction
-                # (dataset_loader.cpp:957-1040)
-                from .parallel.dist_data import find_bin_mappers_distributed
-                mappers = find_bin_mappers_distributed(
-                    raw, retries=conf.network_retries, **bin_kw)
-            else:
-                mappers = find_bin_mappers(raw, **bin_kw)
-            _mark("find_bins_s")
-            binned, bins_dev, stream_meta = self._stream_encode_to_device(
-                raw, mappers, conf, distributed, phases, _mark)
-            from . import binning as _binning
-            phases["encoder"] = _binning.LAST_ENCODE_PATH
-        self.mappers = binned.mappers
-        self.feature_map = binned.feature_map
-        self.bundle_meta = None
-        if sparse_in:
+            self.mappers = binned.mappers
+            self.feature_map = binned.feature_map
+            self.bundle_meta = None
             # sparse path: full host matrix exists; plan from its own
             # internal 50k sample (pre-stream behavior)
             meta = self._plan_efb(conf, binned.bins, self.mappers,
@@ -285,14 +256,80 @@ class Dataset:
                 from .efb import apply_bundles
                 self.bundle_meta = meta
                 binned.bins = apply_bundles(binned.bins, meta)
+            self._derive_names(columns, raw.shape[1])
+            num_bins, na_bin, mtypes, maxb = self._derive_meta()
+            _mark("efb_s")
+            self._finish_device(binned.bins, num_bins, na_bin, mtypes, maxb)
+            _mark("device_put_s")
+            log.info("Dataset.construct phases: %s", phases)
+            return self
+
+        # ---- dense path: metadata-first, then the streamed ingest pipeline
+        if conf.num_machines > 1:
+            from .parallel.mesh import init_distributed
+            init_distributed(conf)
+            distributed = jax.process_count() > 1
+        if distributed:
+            # distributed bin finding: feature slices per rank + mapper
+            # allgather — identical mappers on every rank by construction
+            # (dataset_loader.cpp:957-1040)
+            from .parallel.dist_data import find_bin_mappers_distributed
+            mappers = find_bin_mappers_distributed(
+                raw, retries=conf.network_retries, **bin_kw)
         else:
-            self.bundle_meta = stream_meta
-        if self.feature_name != "auto" and isinstance(self.feature_name, (list, tuple)):
+            mappers = find_bin_mappers(raw, **bin_kw)
+        _mark("find_bins_s")
+        # EFB plan from the pre-drawn sample — the identical 50k-row sample
+        # plan_bundles would draw from the full matrix, so the plan is
+        # bit-identical to planning post-encode — which makes the FULL
+        # dataset metadata (widths, bin counts, padded shapes) known before
+        # a single bulk chunk is encoded
+        n_rows = raw.shape[0]
+        rng = np.random.RandomState(conf.data_random_seed)
+        sample_idx = (None if n_rows <= self._EFB_PLAN_SAMPLE
+                      else rng.choice(n_rows, self._EFB_PLAN_SAMPLE,
+                                      replace=False))
+        sample = bin_data(raw if sample_idx is None else raw[sample_idx],
+                          mappers)
+        self.mappers = sample.mappers
+        self.feature_map = sample.feature_map
+        self.bundle_meta = self._plan_efb(conf, sample.bins, sample.mappers,
+                                          sample.feature_map, distributed,
+                                          presampled=True)
+        sample.bins = None   # host sample no longer needed
+        _mark("efb_plan_s")
+        self._derive_names(columns, raw.shape[1])
+        num_bins, na_bin, mtypes, maxb = self._derive_meta()
+        self._publish_meta(num_bins, na_bin, mtypes, maxb)
+        # shapes are now final: compile the fused train step in the
+        # background while the pipeline below encodes/uploads the bulk rows
+        from . import prewarm as _prewarm
+        self._prewarm = _prewarm.maybe_start(conf, self)
+        from .ingest import stream_encode_upload
+        bins_dev = stream_encode_upload(
+            raw, mappers, self.bundle_meta, width=int(len(num_bins)),
+            chunk_rows=conf.ingest_chunk_rows,
+            encode_threads=conf.encode_threads, phases=phases)
+        from . import binning as _binning
+        phases["encoder"] = _binning.LAST_ENCODE_PATH
+        _mark("stream_s")   # wall time of the overlapped pipeline
+        self._finish_device(bins_dev, num_bins, na_bin, mtypes, maxb)
+        _mark("device_put_s")
+        log.info("Dataset.construct phases: %s", phases)
+        return self
+
+    def _derive_names(self, columns, ncols: int) -> None:
+        if self.feature_name != "auto" and isinstance(self.feature_name,
+                                                      (list, tuple)):
             self._names = list(self.feature_name)
         elif columns is not None:
             self._names = [str(c) for c in columns]
         else:
-            self._names = [f"Column_{i}" for i in range(raw.shape[1])]
+            self._names = [f"Column_{i}" for i in range(ncols)]
+
+    def _derive_meta(self):
+        """Per-column (num_bins, na_bin, missing_type, max bins) from the
+        mappers + EFB plan — pure metadata, independent of the bulk encode."""
         if self.bundle_meta is not None:
             meta = self.bundle_meta
             num_bins = meta.num_bins.astype(np.int32)
@@ -303,16 +340,14 @@ class Dataset:
                 [self.mappers[mem[0][0]].missing_type if len(mem) == 1 else 0
                  for mem in meta.members], dtype=np.int32)
         else:
-            num_bins = np.array([m.num_bins for m in self.mappers], dtype=np.int32)
-            na_bin = np.array([m.na_bin for m in self.mappers], dtype=np.int32)
-            mtypes = np.array([m.missing_type for m in self.mappers], dtype=np.int32)
+            num_bins = np.array([m.num_bins for m in self.mappers],
+                                dtype=np.int32)
+            na_bin = np.array([m.na_bin for m in self.mappers],
+                              dtype=np.int32)
+            mtypes = np.array([m.missing_type for m in self.mappers],
+                              dtype=np.int32)
         maxb = int(num_bins.max()) if len(num_bins) else 1
-        _mark("efb_s")
-        self._finish_device(bins_dev if bins_dev is not None else binned.bins,
-                            num_bins, na_bin, mtypes, maxb)
-        _mark("device_put_s")
-        log.info("Dataset.construct phases: %s", phases)
-        return self
+        return num_bins, na_bin, mtypes, maxb
 
     def _plan_efb(self, conf, sample_bins, mappers, feature_map, distributed,
                   presampled):
@@ -360,101 +395,18 @@ class Dataset:
                             seed=conf.data_random_seed, exclude=excl,
                             reduce_fn=reduce_fn, **kw)
 
-    # rows per streamed upload chunk: ~56 MB at 28 features — big enough to
-    # hit full tunnel bandwidth (measured flat from 56 MB up), small enough
-    # that encode(i+1) overlaps upload(i)
-    _STREAM_CHUNK_ROWS = 2_000_000
     _EFB_PLAN_SAMPLE = 50_000   # plan_bundles' own default sample size
 
-    def _stream_encode_to_device(self, raw, mappers, conf, distributed,
-                                 phases, _mark):
-        """Encode the dense matrix in row chunks and ship each chunk to the
-        device from a background thread while the native encoder works on the
-        next one (VERDICT r4 weak #2: a monolithic post-encode device_put
-        serialized a 280 MB transfer *after* all host work; overlapped, the
-        construct tail is max(encode, upload) instead of the sum).
+    def _publish_meta(self, num_bins_np, na_bin_np, mtypes_np, maxb):
+        """Upload the per-column metadata (and label/weight) to device.
 
-        Returns (BinnedDataset with host bins=None, device bins [N, F_b],
-        bundle meta or None). The EFB plan is derived before bulk encode from
-        the same sample plan_bundles would draw, so streamed chunks can be
-        bundled on the fly and the unbundled matrix never exists on device."""
-        import queue as _queue
-        import threading
-
-        n = raw.shape[0]
-        rng = np.random.RandomState(conf.data_random_seed)
-        sample_idx = (None if n <= self._EFB_PLAN_SAMPLE
-                      else rng.choice(n, self._EFB_PLAN_SAMPLE, replace=False))
-        sample = bin_data(raw if sample_idx is None else raw[sample_idx],
-                          mappers)
-        meta = self._plan_efb(conf, sample.bins, sample.mappers,
-                              sample.feature_map, distributed, presampled=True)
-        _mark("efb_plan_s")
-
-        from .efb import apply_bundles
-        state = {"acc": None, "upload_s": 0.0, "exc": None}
-        q: "_queue.Queue" = _queue.Queue(maxsize=2)
-
-        def _uploader():
-            while True:
-                item = q.get()
-                if item is None:
-                    return
-                if state["exc"] is not None:
-                    continue   # keep draining so producer puts never block
-                try:
-                    s0, cb = item
-                    t0 = time.time()
-                    dev = jax.device_put(cb)
-                    if state["acc"] is None:
-                        state["acc"] = jnp.zeros((n, cb.shape[1]), cb.dtype)
-                    state["acc"] = _set_rows(state["acc"], dev,
-                                             jnp.int32(s0))
-                    # block: upload_s must measure transfer completion, not
-                    # async enqueue, or the phase report under-counts it
-                    state["acc"].block_until_ready()
-                    state["upload_s"] += time.time() - t0
-                except BaseException as e:   # surfaced after join
-                    state["exc"] = e
-
-        th = threading.Thread(target=_uploader, daemon=True)
-        th.start()
-        encode_s = 0.0
-        try:
-            for s0 in range(0, n, self._STREAM_CHUNK_ROWS):
-                t0 = time.time()
-                cb = bin_data(raw[s0: s0 + self._STREAM_CHUNK_ROWS],
-                              mappers).bins
-                if meta is not None:
-                    cb = apply_bundles(cb, meta)
-                encode_s += time.time() - t0
-                q.put((s0, np.ascontiguousarray(cb)))
-        finally:
-            q.put(None)
-            th.join()
-        if state["exc"] is not None:
-            raise state["exc"]
-        phases["encode_s"] = round(encode_s, 3)
-        phases["upload_s"] = round(state["upload_s"], 3)
-        _mark("stream_s")   # wall time of the overlapped encode+upload loop
-        bins_dev = state["acc"]
-        if bins_dev is None:   # zero-row input: nothing streamed
-            bins_dev = jnp.zeros((0, len(sample.mappers)), jnp.uint8)
-        sample.bins = None   # host sample no longer needed
-        return sample, bins_dev, meta
-
-    def _finish_device(self, bins_np, num_bins_np, na_bin_np, mtypes_np, maxb):
-        """Ship the binned dataset to device. All metadata arguments are HOST
-        numpy arrays — never device arrays: a host readback right after the
-        async 280 MB bins upload serializes on the transfer queue (measured
-        13 s at 10M rows on the axon runtime)."""
-        # device_put, NOT jnp.asarray: asarray on a large host uint8 matrix
-        # takes a pathological conversion path (~22 s for 10M x 28 measured on
-        # the axon runtime vs 0.5 s for device_put + relayout-on-first-use)
-        if isinstance(bins_np, jax.Array):
-            self.bins = bins_np   # streamed path: already uploaded in chunks
-        else:
-            self.bins = jax.device_put(np.ascontiguousarray(bins_np))
+        All metadata arguments are HOST numpy arrays — never device arrays:
+        a host readback right after the async 280 MB bins upload serializes
+        on the transfer queue (measured 13 s at 10M rows on the axon
+        runtime). Called BEFORE the bulk ingest pipeline so everything the
+        background AOT prewarm needs (padded shapes, device label for the
+        objective's captured constants) exists while the bins stream —
+        idempotent via the jax.Array guards."""
         self._num_bins_np = np.asarray(num_bins_np, np.int32)
         self._mtypes_np = np.asarray(mtypes_np, np.int32)
         self.num_bins_dev = jax.device_put(self._num_bins_np)
@@ -464,11 +416,23 @@ class Dataset:
         self._na_bin_raw = na
         self.missing_type_dev = jax.device_put(self._mtypes_np)
         self.max_num_bins = int(maxb)
-        self._num_data = bins_np.shape[0]
-        if self.label is not None:
+        self._num_features_used = int(len(self._num_bins_np))
+        if self.label is not None and not isinstance(self.label, jax.Array):
             self.label = jax.device_put(np.asarray(self.label, np.float32))
-        if self.weight is not None:
+        if self.weight is not None and not isinstance(self.weight, jax.Array):
             self.weight = jax.device_put(np.asarray(self.weight, np.float32))
+
+    def _finish_device(self, bins_np, num_bins_np, na_bin_np, mtypes_np, maxb):
+        """Ship the binned dataset to device and mark construction done."""
+        # device_put, NOT jnp.asarray: asarray on a large host uint8 matrix
+        # takes a pathological conversion path (~22 s for 10M x 28 measured on
+        # the axon runtime vs 0.5 s for device_put + relayout-on-first-use)
+        if isinstance(bins_np, jax.Array):
+            self.bins = bins_np   # streamed path: already uploaded in chunks
+        else:
+            self.bins = jax.device_put(np.ascontiguousarray(bins_np))
+        self._publish_meta(num_bins_np, na_bin_np, mtypes_np, maxb)
+        self._num_data = bins_np.shape[0]
         self._constructed = True
         if self.free_raw_data:
             self.raw_data = None
@@ -607,6 +571,10 @@ class Dataset:
     def num_features(self) -> int:
         if self._constructed:
             return self.bins.shape[1]
+        if self._num_features_used is not None:
+            # metadata published but bins still streaming (the window where
+            # the background AOT prewarm builds its trainer): F_b is final
+            return self._num_features_used
         return self._num_features_raw
 
     def num_feature(self) -> int:

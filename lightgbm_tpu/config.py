@@ -173,6 +173,17 @@ _PARAMS: Dict[str, Tuple[Any, Tuple[str, ...]]] = {
     "grow_policy": ("depthwise", ()),      # depthwise | lossguide (leaf-wise)
     "hist_dtype": ("float32", ()),         # histogram accumulator dtype
     "mesh_axis": ("data", ()),             # mesh axis name for data-parallel sharding
+    # ---- cold-start pipeline (new in this framework; see ingest.py/prewarm.py) ----
+    # rows per streamed ingest chunk (encode -> H2D -> commit pipeline);
+    # ~56 MB of uint8 bins at 28 features — big enough for full tunnel
+    # bandwidth, small enough that stages overlap
+    "ingest_chunk_rows": (2_000_000, ("stream_chunk_rows",)),
+    # host threads for the chunked bin-encode stage; 0 = auto (the native
+    # encoder releases the GIL, so chunks genuinely encode in parallel)
+    "encode_threads": (0, ()),
+    # background AOT compile of the fused train step during dataset
+    # construction (prewarm=0 kills it; serial tree learner only)
+    "prewarm": (True, ()),
     # ---- fault tolerance (new in this framework) ----
     # where snapshot_freq dumps go; "" = the directory of output_model
     # (the reference writes into CWD from every process, gbdt.cpp:291)
@@ -312,6 +323,10 @@ class Config:
                       f"clip, got {self.nonfinite_policy!r}")
         if self.snapshot_keep < 1:
             log.fatal("snapshot_keep must be >= 1")
+        if self.ingest_chunk_rows < 1:
+            log.fatal("ingest_chunk_rows must be >= 1")
+        if self.encode_threads < 0:
+            log.fatal("encode_threads must be >= 0 (0 = auto)")
         if self.network_retries < 1:
             log.fatal("network_retries must be >= 1")
 

@@ -70,6 +70,13 @@ def train(params: Dict[str, Any], train_set: Dataset,
         train_set.categorical_feature = categorical_feature
 
     booster = Booster(params=params, train_set=train_set)
+    _ph = getattr(booster._gbdt, "_prewarm_handle", None)
+    if _ph is not None:
+        # background AOT compile kicked by Dataset.construct (prewarm.py);
+        # the first boosting dispatch joins it instead of compiling inline
+        log.debug("AOT prewarm %s at trainer creation; first dispatch "
+                  "will join it",
+                  "already finished" if _ph.done() else "still compiling")
     if init_model is not None:
         _warm_start(booster, init_model)
 

@@ -1,0 +1,258 @@
+"""Chunked three-stage ingest pipeline: encode -> H2D -> commit.
+
+Reference analog: ``PipelineReader`` (utils/pipeline_reader.h), which
+prefetches+parses the next block on a background thread while the consumer
+works on the current one, and the OpenCL learner's async feature-matrix
+transfer (gpu_tree_learner.cpp). Here the same shape feeds the TPU:
+
+- **encode** — a pool of ``encode_threads`` host workers bins row chunks
+  (``binning.bin_data`` + EFB ``apply_bundles``; the native encoder releases
+  the GIL, so chunks genuinely encode in parallel),
+- **H2D** — one uploader thread ``jax.device_put``s each encoded chunk;
+  the bounded queue in front of it keeps at most two chunks in flight
+  (double buffering), so chunk i+1 transfers while chunk i commits,
+- **commit** — one thread folds each uploaded chunk into a single donated
+  device accumulator (``_set_rows``) and blocks for completion, which is
+  what backpressures the whole pipeline to device speed.
+
+Every stage communicates over bounded queues: a full queue blocks the
+producer (backpressure), a ``None`` sentinel terminates each consumer, and
+the first exception from any stage is stashed and re-raised on the caller's
+thread after join — the same protocol as serving.py's chunked predictor.
+
+Determinism: chunk boundaries depend only on ``chunk_rows``; each chunk is
+encoded by a pure per-row function; commits write DISJOINT row ranges of the
+accumulator, so neither the number of encode threads nor the completion
+order can change a single bit of the result (asserted by
+tests/test_ingest_pipeline.py).
+
+Thread-safety: the module-level last-run stats are guarded by
+``_STATS_LOCK`` — this module is in the ``unlocked-shared-state`` tpu-lint
+scope, same as serving.py and obs/.
+"""
+from __future__ import annotations
+
+import queue
+import threading
+import time
+from typing import Any, Dict, Optional
+
+import jax
+import jax.numpy as jnp
+import numpy as np
+
+from . import obs
+from .binning import bin_data
+from .utils import log
+
+# accumulate rows into ONE preallocated device buffer via a donated
+# dynamic-update (peak device memory 1x + in-flight chunks; a concatenate of
+# all chunks would transiently hold 2x). Module-level so the jit wrapper (and
+# its trace cache) is shared across Dataset constructions instead of being
+# rebuilt — and retraced — per call.
+_set_rows = jax.jit(
+    lambda acc, chunk, s0: jax.lax.dynamic_update_slice(acc, chunk, (s0, 0)),
+    donate_argnums=0)
+
+# stats of the most recent pipeline run (profiling surface for
+# scripts/profile_ingest.py and the bench); guarded: construct can run from
+# a worker thread while a profiler thread reads
+_STATS_LOCK = threading.Lock()
+LAST_INGEST_STATS: Dict[str, Any] = {}
+
+
+def resolve_encode_threads(requested: int) -> int:
+    """0 = auto: enough threads to keep encode off the critical path without
+    oversubscribing the host (the native encoder may also use num_threads
+    internally per call)."""
+    if requested and requested > 0:
+        return int(requested)
+    import os
+    return max(1, min(4, os.cpu_count() or 1))
+
+
+def overlap_efficiency(stage_spans, wall_s: float) -> float:
+    """How much of the *possible* stage overlap was realized, in [0, 1].
+
+    ``stage_spans`` are per-stage ideal busy spans (seconds). With no overlap
+    the wall is their sum; with perfect overlap it is their max. The ratio is
+    (sum - wall) / (sum - max), clamped — 1.0 when one stage dominates so
+    completely that there is nothing to hide."""
+    total = float(sum(stage_spans))
+    longest = float(max(stage_spans)) if stage_spans else 0.0
+    max_savable = total - longest
+    if max_savable <= 1e-9:
+        return 1.0
+    saved = total - float(wall_s)
+    return max(0.0, min(1.0, saved / max_savable))
+
+
+def stream_encode_upload(raw, mappers, meta, *, width: int,
+                         chunk_rows: int, encode_threads: int = 0,
+                         phases: Optional[Dict[str, Any]] = None):
+    """Run the three-stage pipeline over ``raw`` [N, F_raw] and return the
+    device bin matrix [N, width] uint8.
+
+    ``meta`` is the (already planned) EFB bundle meta or None; bundling is
+    applied per chunk inside the encode stage so the unbundled matrix never
+    exists on device. ``phases`` (optional dict) receives the disjoint
+    per-stage busy breakdown + ``overlap_efficiency``.
+    """
+    from .efb import apply_bundles
+
+    n = int(raw.shape[0])
+    if n == 0:
+        return jnp.zeros((0, width), jnp.uint8)
+    chunk_rows = max(1, int(chunk_rows))
+    offsets = list(range(0, n, chunk_rows))
+    threads = min(resolve_encode_threads(encode_threads), len(offsets))
+    tele = obs.enabled()
+
+    work_q: "queue.Queue" = queue.Queue()
+    for ci, s0 in enumerate(offsets):
+        work_q.put((ci, s0))
+    # encoded chunks awaiting H2D: one being transferred + one ready is the
+    # double buffer; a deeper queue would only raise host memory pressure
+    enc_q: "queue.Queue" = queue.Queue(maxsize=2)
+    # uploaded chunks awaiting commit
+    dev_q: "queue.Queue" = queue.Queue(maxsize=2)
+    state: Dict[str, Any] = {"acc": None, "exc": None, "encode_s": 0.0,
+                             "h2d_s": 0.0, "commit_s": 0.0}
+    lock = threading.Lock()
+
+    def _fail(e: BaseException) -> None:
+        with lock:
+            if state["exc"] is None:
+                state["exc"] = e
+
+    def _encode_loop():
+        while True:
+            try:
+                ci, s0 = work_q.get_nowait()
+            except queue.Empty:
+                return
+            with lock:
+                if state["exc"] is not None:
+                    continue   # drain remaining work items without encoding
+            try:
+                t0 = time.perf_counter()
+                cb = bin_data(raw[s0: s0 + chunk_rows], mappers).bins
+                if meta is not None:
+                    cb = apply_bundles(cb, meta)
+                cb = np.ascontiguousarray(cb)
+                dt = time.perf_counter() - t0
+                with lock:
+                    state["encode_s"] += dt
+                enc_q.put((ci, s0, cb, dt))
+            except BaseException as e:   # surfaced after join
+                _fail(e)
+
+    def _h2d_loop():
+        while True:
+            item = enc_q.get()
+            if item is None:
+                dev_q.put(None)
+                return
+            with lock:
+                if state["exc"] is not None:
+                    continue   # keep draining so encoder puts never block
+            try:
+                ci, s0, cb, enc_dt = item
+                t0 = time.perf_counter()
+                dev = jax.device_put(cb)
+                # block for transfer completion: h2d_s must measure the copy,
+                # not the async enqueue — this thread exists so the wait
+                # overlaps encode(i+1) and commit(i-1)
+                dev.block_until_ready()   # tpu-lint: disable=host-sync-in-jit
+                dt = time.perf_counter() - t0
+                with lock:
+                    state["h2d_s"] += dt
+                dev_q.put((ci, s0, dev, cb.shape[0], enc_dt, dt))
+            except BaseException as e:
+                _fail(e)
+
+    def _commit_loop():
+        while True:
+            item = dev_q.get()
+            if item is None:
+                return
+            with lock:
+                if state["exc"] is not None:
+                    continue
+            try:
+                ci, s0, dev, rows, enc_dt, h2d_dt = item
+                t0 = time.perf_counter()
+                if state["acc"] is None:
+                    with lock:
+                        state["acc"] = jnp.zeros((n, width), dev.dtype)
+                with lock:
+                    acc = _set_rows(state["acc"], dev, jnp.int32(s0))
+                    state["acc"] = acc
+                # block: the donated accumulate must finish before the next
+                # donation, and the wait here is the pipeline's backpressure
+                acc.block_until_ready()   # tpu-lint: disable=host-sync-in-jit
+                dt = time.perf_counter() - t0
+                with lock:
+                    state["commit_s"] += dt
+                if tele:
+                    depth = enc_q.qsize() + dev_q.qsize()
+                    obs.METRICS.gauge(
+                        "ingest_pipeline_depth",
+                        "high-water chunks queued between ingest stages"
+                    ).set_max(depth + 1)
+                    obs.METRICS.counter("ingest_chunks",
+                                        "chunks through the pipeline").inc()
+                    obs.emit("ingest_chunk", chunk=int(ci), rows=int(rows),
+                             encode_s=float(enc_dt), h2d_s=float(h2d_dt),
+                             commit_s=float(dt), depth=int(depth))
+            except BaseException as e:
+                _fail(e)
+
+    t_wall = time.perf_counter()
+    encoders = [threading.Thread(target=_encode_loop, daemon=True,
+                                 name=f"ingest-encode-{i}")
+                for i in range(threads)]
+    up = threading.Thread(target=_h2d_loop, daemon=True, name="ingest-h2d")
+    cm = threading.Thread(target=_commit_loop, daemon=True,
+                          name="ingest-commit")
+    for th in encoders:
+        th.start()
+    up.start()
+    cm.start()
+    try:
+        for th in encoders:
+            th.join()
+    finally:
+        enc_q.put(None)   # _h2d_loop forwards the sentinel to _commit_loop
+        up.join()
+        cm.join()
+    if state["exc"] is not None:
+        raise state["exc"]
+    wall = time.perf_counter() - t_wall
+    # per-stage ideal spans: encode busy is summed across workers, so divide
+    # by the pool size for the ideally-parallel span the wall is compared to
+    spans = (state["encode_s"] / max(threads, 1), state["h2d_s"],
+             state["commit_s"])
+    eff = overlap_efficiency(spans, wall)
+    stats = {"encode_s": round(state["encode_s"], 3),
+             "h2d_s": round(state["h2d_s"], 3),
+             "commit_s": round(state["commit_s"], 3),
+             "encode_threads": threads, "chunks": len(offsets),
+             "chunk_rows": chunk_rows, "wall_s": round(wall, 3),
+             "overlap_efficiency": round(eff, 3)}
+    with _STATS_LOCK:
+        LAST_INGEST_STATS.clear()
+        LAST_INGEST_STATS.update(stats)
+    if phases is not None:
+        phases["stream_busy"] = {k: stats[k] for k in
+                                 ("encode_s", "h2d_s", "commit_s",
+                                  "encode_threads", "chunks")}
+        phases["overlap_efficiency"] = stats["overlap_efficiency"]
+    log.debug("ingest pipeline: %s", stats)
+    return state["acc"]
+
+
+def last_stats() -> Dict[str, Any]:
+    """Copy of the most recent pipeline run's stage breakdown."""
+    with _STATS_LOCK:
+        return dict(LAST_INGEST_STATS)

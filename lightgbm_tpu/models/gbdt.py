@@ -41,7 +41,10 @@ class GBDT:
     _supports_fused = True        # subclasses opt out (e.g. per-iter resampling)
 
     def __init__(self, config: Config, train_set, objective,
-                 metrics: Optional[List] = None):
+                 metrics: Optional[List] = None, quiet: bool = False):
+        # quiet=True builds the trainer for background AOT prewarming
+        # (prewarm.py): identical traced program, but no user-facing
+        # warnings duplicated from the real construction that follows
         self.config = config
         self.train_set = train_set
         self.objective = objective
@@ -236,7 +239,8 @@ class GBDT:
                 lazy_pen=jnp.asarray(
                     cegb_lazy_v if lazy_on else np.zeros(F),
                     dtype=jnp.float32))
-        self._warn_unconsumed(config)
+        if not quiet:
+            self._warn_unconsumed(config)
         self._forced_dev = self._build_forced(config, train_set)
         self._bag_rng = np.random.RandomState(config.bagging_seed)
         self._feat_rng = np.random.RandomState(config.feature_fraction_seed)
@@ -307,6 +311,15 @@ class GBDT:
                 self._cegb_dev = self._cegb_dev._replace(
                     data_used=shard_rows(du, self._mesh))
             log.info(f"data-parallel tree learner over {nd} devices")
+        # background AOT compile handed over by Dataset.construct (prewarm.py);
+        # resolved lazily at the first _fused_step dispatch so the compile
+        # keeps overlapping whatever runs between construction and training.
+        # quiet=True IS the prewarm trainer — it must not adopt itself.
+        self._prewarm_handle = (getattr(train_set, "_prewarm", None)
+                                if not (quiet or self._dp or self._fp)
+                                else None)
+        self._step_aot = None   # adopted Compiled executable (auto path)
+        self._aot_dispatches = 0
 
     def _cegb_setup(self, config, train_set):
         """CEGB config validation + penalty-vector mapping into grower feature
@@ -838,10 +851,12 @@ class GBDT:
     def _fused_step(self, grad, hess):
         custom = grad is not None
         key = "_step_custom" if custom else "_step_auto"
-        fn = getattr(self, key, None)
-        if fn is None:
-            fn = self._build_fused_step(custom)
-            setattr(self, key, fn)
+        if not custom and self._prewarm_handle is not None:
+            # the before-first-dispatch barrier: join the background compile
+            # and take its executable (None on spec mismatch/error)
+            from .. import prewarm as _prewarm
+            handle, self._prewarm_handle = self._prewarm_handle, None
+            self._step_aot = _prewarm.adopt(handle, self)
         ts = self.train_set
         n = ts.num_data
         if self._bag_mask is not None:
@@ -861,14 +876,33 @@ class GBDT:
                                         self._fp_na_bin)
         else:
             bins_arg, nb_arg, na_arg = ts.bins, ts.num_bins_dev, ts.na_bin_dev
-        trees, new_score, cegb_out, ok = fn(
-            bins_arg, nb_arg, na_arg,
-            self.train_score, self._feature_mask(), bag,
-            grad if custom else dummy,
-            hess if custom else dummy,
-            jnp.float32(shrink), jnp.int32(self.iter_),
-            jnp.float32(self.iter_ + 1), cegb_in)
-        self._obs_track_compiles(key, fn)
+        args = (bins_arg, nb_arg, na_arg,
+                self.train_score, self._feature_mask(), bag,
+                grad if custom else dummy,
+                hess if custom else dummy,
+                jnp.float32(shrink), jnp.int32(self.iter_),
+                jnp.float32(self.iter_ + 1), cegb_in)
+        trees = None
+        if not custom and self._step_aot is not None:
+            try:
+                # prewarmed executables are dispatched directly — AOT
+                # compilation never enters the jit wrapper's cache, so going
+                # through the wrapper would compile the same program twice
+                trees, new_score, cegb_out, ok = self._step_aot(*args)
+                self._aot_dispatches += 1
+            except TypeError as e:
+                # aval drift vs the lowering (e.g. an objective swapped in
+                # after prewarm): compile at dispatch like before
+                log.warning("prewarmed step rejected the training arguments "
+                            f"({e}); compiling at dispatch")
+                self._step_aot = None
+        if trees is None:
+            fn = getattr(self, key, None)
+            if fn is None:
+                fn = self._build_fused_step(custom)
+                setattr(self, key, fn)
+            trees, new_score, cegb_out, ok = fn(*args)
+            self._obs_track_compiles(key, fn)
         k = self.num_tree_per_iteration
         if k > 8:
             # scan path returns class-stacked TreeArrays; unstack in ONE

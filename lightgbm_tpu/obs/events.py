@@ -54,6 +54,15 @@ EVENT_SCHEMAS: Dict[str, Tuple[Dict[str, Any], Dict[str, Any]]] = {
     # version change invalidated the cached one)
     "engine_upload": ({"n_trees": int, "num_class": int},
                       {"reason": str, "duration_s": _NUM}),
+    # one chunk made it through the three-stage ingest pipeline
+    # (ingest.py): per-stage durations + queue depth observed at commit
+    "ingest_chunk": ({"chunk": int, "rows": int},
+                     {"encode_s": _NUM, "h2d_s": _NUM, "commit_s": _NUM,
+                      "depth": int}),
+    # background AOT compile lifecycle (prewarm.py): started -> compiled ->
+    # adopted, or skipped/miss/error with a reason; duration_s is the
+    # compile time (compiled/error), or the join-barrier wait (adopted)
+    "aot_prewarm": ({"phase": str}, {"duration_s": _NUM, "reason": str}),
     "fault_injected": ({"point": str}, {"hit": int}),
     "dist_retry": ({"name": str, "attempt": int},
                    {"error": str, "delay_s": _NUM}),

@@ -1,0 +1,234 @@
+"""Background AOT compilation of the fused train step (cold-start overlap).
+
+``BENCH_r05.json`` spends compile_s=49.45 before the first boosting
+iteration — an order of magnitude more than the 20-iteration training loop
+itself. All of that tracing/lowering/XLA work needs only the *shapes* of the
+training arguments, and ``Dataset.construct`` fixes every one of them (N,
+F_b, B, L, k) the moment bin mappers + the EFB plan exist — minutes of bulk
+encode/upload before the first dispatch at the 10M bench scale. So: as soon
+as the dataset publishes its metadata, ``maybe_start`` builds the same
+trainer the Booster will build, lowers the fused step against
+``ShapeDtypeStruct``s, and compiles it on a daemon thread concurrent with
+the ingest pipeline.
+
+Adoption is by *executable*, not by jit cache: on this jax version a
+``lower().compile()`` does NOT populate the jit wrapper's dispatch cache
+(measured: ``fn._cache_size()`` stays 0 and the first wrapper call compiles
+again), so the trainer dispatches the returned ``Compiled`` object directly.
+That requires the argument avals to match the lowering EXACTLY —
+``step_avals`` mirrors ``GBDT._fused_step``'s argument construction
+(``jnp.float32``/``jnp.int32`` scalars included, which have different cache
+identities than numpy or weak-typed python scalars) and ``adopt`` verifies a
+structural spec of everything that shapes the traced program, falling back
+to plain jit dispatch on any mismatch. The join in ``adopt`` is the barrier
+before first dispatch the pipeline design calls for.
+
+Scope: the serial single-process tree learner with a built-in objective
+(plain gbdt boosting). Everything else — dp/fp sharding, GOSS's custom-grad
+step, dart's reweighting — skips the prewarm and compiles at first dispatch
+exactly as before. ``prewarm=0`` is the kill switch.
+"""
+from __future__ import annotations
+
+import threading
+import time
+from typing import Any, Dict, Optional
+
+import numpy as np
+
+from . import obs
+from .utils import log
+
+# config fields that shape the traced step program beyond what the
+# structural fields (gp, k, n, f, flags) already capture — objective family
+# and its hyperparameters, grower selection, and histogram variants
+_SPEC_KEYS = (
+    "objective", "num_class", "boosting", "sigmoid", "alpha", "fair_c",
+    "poisson_max_delta_step", "tweedie_variance_power", "is_unbalance",
+    "scale_pos_weight", "reg_sqrt", "boost_from_average", "grow_policy",
+    "histogram_impl", "use_quantized_grad", "hist_dtype", "nonfinite_policy",
+    "tree_learner", "top_k", "label_gain", "lambdarank_truncation_level",
+    "lambdarank_norm", "histogram_pool_size", "forcedsplits_filename",
+    "feature_fraction_bynode", "learning_rate",
+)
+
+
+class PrewarmHandle:
+    """One background compile: join() is the pre-dispatch barrier; ``spec``
+    and ``result`` are written by the worker before the thread exits, so
+    they are safely visible to any thread that joined."""
+
+    def __init__(self) -> None:
+        self.spec: Optional[Dict[str, Any]] = None
+        self.result: Dict[str, Any] = {}
+        self._thread: Optional[threading.Thread] = None
+
+    def join(self, timeout: Optional[float] = None) -> "PrewarmHandle":
+        if self._thread is not None:
+            self._thread.join(timeout)
+        return self
+
+    def done(self) -> bool:
+        return self._thread is None or not self._thread.is_alive()
+
+
+def step_spec(gbdt) -> Dict[str, Any]:
+    """Everything that determines the traced fused-step program (beyond the
+    argument avals): compared between the prewarmed trainer and the real one
+    before the executable is adopted."""
+    ts = gbdt.train_set
+    conf = gbdt.config
+    return {
+        "class": type(gbdt).__name__,
+        "k": int(gbdt.num_tree_per_iteration),
+        "gp": gbdt.gp,
+        "nf": gbdt._nf_policy,
+        "avg": bool(gbdt.average_output),
+        "obj": type(gbdt.objective).__name__ if gbdt.objective else None,
+        "n": int(ts.num_data),
+        "f": int(ts.num_features),
+        "bundle": getattr(ts, "bundle_meta", None) is not None,
+        "cegb": gbdt._cegb_dev is not None,
+        "forced": gbdt._forced_dev is not None,
+        "dp": bool(gbdt._dp),
+        "fp": bool(gbdt._fp),
+        "conf": {k: getattr(conf, k, None) for k in _SPEC_KEYS},
+    }
+
+
+def step_avals(gbdt):
+    """ShapeDtypeStructs matching GBDT._fused_step's serial-path argument
+    construction exactly (order and dtypes included)."""
+    import jax
+    ts = gbdt.train_set
+    n, f = int(ts.num_data), int(ts.num_features)
+    k = gbdt.num_tree_per_iteration
+    S = jax.ShapeDtypeStruct
+    score = S((n,) if k == 1 else (n, k), np.float32)
+    sc_f = S((), np.float32)
+    cegb = (jax.tree_util.tree_map(lambda a: S(a.shape, a.dtype),
+                                   gbdt._cegb_dev)
+            if gbdt._cegb_dev is not None else sc_f)
+    return (S((n, f), np.uint8),        # bins
+            S((f,), np.int32),          # num_bins
+            S((f,), np.int32),          # na_bin
+            score,                      # train score
+            S((f,), np.bool_),          # feature mask
+            S((n,), np.float32),        # bag weights
+            sc_f, sc_f,                 # grad/hess dummies (auto path)
+            sc_f,                       # shrink
+            S((), np.int32),            # qseed
+            sc_f,                       # titer
+            cegb)
+
+
+def aot_compile_step(gbdt, fn=None, tag: str = "cold"):
+    """Lower + XLA-compile the auto fused step out of band. Returns
+    (jit wrapper, Compiled executable, seconds). ``tag`` labels the compile
+    event cold/warm so the bench can split the two without guessing."""
+    if fn is None:
+        fn = gbdt._build_fused_step(custom=False)
+    t0 = time.perf_counter()
+    compiled = fn.lower(*step_avals(gbdt)).compile()
+    dt = time.perf_counter() - t0
+    if obs.enabled():
+        # cache_size 0: AOT compilation does not enter the wrapper's
+        # dispatch cache (the whole reason adoption hands over `compiled`)
+        obs.emit("compile", what="fused_step_aot", cache_size=0,
+                 duration_s=float(dt), key=tag)
+    return fn, compiled, dt
+
+
+# below this the encode/upload window is far shorter than the compile it
+# would hide, and Datasets that are constructed but never trained (valid
+# sets, serialization round-trips) would burn a whole wasted XLA compile —
+# at bench scale (10M rows) the ingest takes long enough to hide all of it
+MIN_PREWARM_ROWS = 200_000
+
+
+def _skip_reason(conf, dataset) -> Optional[str]:
+    if not conf.prewarm:
+        return "prewarm=0"
+    n = int(dataset.num_data or 0)
+    if n < MIN_PREWARM_ROWS:
+        return f"num_data={n} < {MIN_PREWARM_ROWS} (nothing to hide behind)"
+    if conf.boosting not in ("gbdt", "gbrt"):
+        return f"boosting={conf.boosting} (custom-step variants recompile)"
+    if conf.tree_learner not in ("serial",):
+        return f"tree_learner={conf.tree_learner} (sharded args differ)"
+    if conf.num_machines > 1:
+        return "num_machines>1"
+    if dataset.label is None:
+        return "no label (nothing to train)"
+    return None
+
+
+def maybe_start(conf, dataset) -> Optional[PrewarmHandle]:
+    """Kick the background compile if the configuration is in scope.
+    Called by Dataset.construct right after metadata publication — i.e.
+    before the bulk encode/upload the compile is meant to hide behind."""
+    reason = _skip_reason(conf, dataset)
+    tele = obs.enabled()
+    if reason is not None:
+        if tele:
+            obs.emit("aot_prewarm", phase="skipped", reason=reason)
+        log.debug("AOT prewarm skipped: %s", reason)
+        return None
+    handle = PrewarmHandle()
+
+    def _worker():
+        t0 = time.perf_counter()
+        try:
+            from .models.gbdt import GBDT
+            from .objectives import create_objective
+            objective = create_objective(conf.objective, conf)
+            g = GBDT(conf, dataset, objective, metrics=[], quiet=True)
+            handle.spec = step_spec(g)
+            fn, compiled, _ = aot_compile_step(g, tag="cold")
+            handle.result.update(fn=fn, compiled=compiled,
+                                 duration_s=time.perf_counter() - t0)
+            if tele:
+                obs.emit("aot_prewarm", phase="compiled",
+                         duration_s=float(handle.result["duration_s"]))
+        except BaseException as e:   # surfaced as a miss at adoption time
+            handle.result["error"] = e
+            if tele:
+                obs.emit("aot_prewarm", phase="error",
+                         reason=str(e)[:200],
+                         duration_s=time.perf_counter() - t0)
+
+    th = threading.Thread(target=_worker, daemon=True, name="aot-prewarm")
+    handle._thread = th
+    if tele:
+        obs.emit("aot_prewarm", phase="started")
+    th.start()
+    return handle
+
+
+def adopt(handle: PrewarmHandle, gbdt):
+    """Join the background compile (the before-first-dispatch barrier) and
+    return its Compiled executable iff it was built for exactly this
+    trainer's step program; None means compile at dispatch as usual."""
+    t0 = time.perf_counter()
+    handle.join()
+    wait = time.perf_counter() - t0
+    tele = obs.enabled()
+    err = handle.result.get("error")
+    if err is not None:
+        if tele:
+            obs.emit("aot_prewarm", phase="miss",
+                     reason=f"background compile failed: {str(err)[:160]}")
+        log.debug("AOT prewarm unusable (%r); compiling at dispatch", err)
+        return None
+    if handle.spec != step_spec(gbdt):
+        if tele:
+            obs.emit("aot_prewarm", phase="miss", reason="spec mismatch")
+        log.info("prewarmed step does not match the trainer configuration; "
+                 "compiling at dispatch")
+        return None
+    if tele:
+        obs.emit("aot_prewarm", phase="adopted", duration_s=float(wait))
+        obs.METRICS.counter("aot_prewarm_hits",
+                            "prewarmed step executables adopted").inc()
+    log.debug("adopted prewarmed fused step (barrier wait %.3fs)", wait)
+    return handle.result["compiled"]

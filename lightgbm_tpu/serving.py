@@ -21,7 +21,7 @@ actual routing work.
 - matrices larger than ``chunk_rows`` stream through bounded double-buffered
   chunks: a producer thread pseudo-bins chunk i+1 on the host (f64, exact)
   while the device routes chunk i — the same overlap pattern as the training
-  ingest pipeline (basic.py _stream_encode_to_device).
+  ingest pipeline (ingest.py stream_encode_upload).
 
 Outputs are bit-identical to the direct path (ops/predict.py via
 Booster.predict): pseudo-binning is unchanged, every device kernel is

@@ -313,9 +313,78 @@ def test_shared_state_lock_and_out_of_scope_clean():
     rel = "lightgbm_tpu/obs/whatever.py"
     assert "unlocked-shared-state" not in names(
         analyze_source(SHARED_LOCKED, relpath=rel))
-    # identical mutation outside serving/obs is the normal idiom: no finding
+    # identical mutation outside serving/obs/ingest is the normal idiom
     assert "unlocked-shared-state" not in names(
         analyze_source(SHARED_BAD, relpath="lightgbm_tpu/engine.py"))
+
+
+# ---- ingest-pipeline rule scopes (PR: pipelined cold-start) ----
+# the chunked ingest module is multi-threaded, so both threading rules
+# extend their scope to it; each gets its own fire / suppressed / clean trio
+
+INGEST_HOT_LOOP_BAD = """
+def _commit_loop():
+    while True:
+        acc = step()
+        acc.block_until_ready()
+"""
+
+INGEST_HOT_LOOP_SUPPRESSED = """
+def _h2d_loop():
+    while True:
+        dev = put()
+        dev.block_until_ready()   # tpu-lint: disable=host-sync-in-jit
+"""
+
+INGEST_HOT_LOOP_CLEAN = """
+def _h2d_loop():
+    while True:
+        dev = put()
+        enqueue(dev)
+"""
+
+INGEST_REL = "lightgbm_tpu/ingest.py"
+
+
+def test_ingest_hot_loops_fire():
+    assert "host-sync-in-jit" in names(
+        analyze_source(INGEST_HOT_LOOP_BAD, relpath=INGEST_REL))
+    # the very same loop body outside the designated module is not audited
+    assert "host-sync-in-jit" not in names(
+        analyze_source(INGEST_HOT_LOOP_BAD, relpath="lightgbm_tpu/efb.py"))
+
+
+def test_ingest_hot_loop_suppressed_and_clean():
+    assert "host-sync-in-jit" not in names(
+        analyze_source(INGEST_HOT_LOOP_SUPPRESSED, relpath=INGEST_REL))
+    kept = analyze_source(INGEST_HOT_LOOP_SUPPRESSED, relpath=INGEST_REL,
+                          keep_suppressed=True)
+    assert "host-sync-in-jit" in names(kept)
+    assert "host-sync-in-jit" not in names(
+        analyze_source(INGEST_HOT_LOOP_CLEAN, relpath=INGEST_REL))
+
+
+INGEST_SHARED_SUPPRESSED = """
+LAST_INGEST_STATS = {}
+
+def update(stats):
+    LAST_INGEST_STATS["x"] = stats  # tpu-lint: disable=unlocked-shared-state
+"""
+
+
+def test_ingest_shared_state_trio():
+    # fires: stats-dict mutation without the lock, inside the new scope
+    assert "unlocked-shared-state" in names(
+        analyze_source(SHARED_BAD, relpath=INGEST_REL))
+    # suppressed inline with a justification comment
+    assert "unlocked-shared-state" not in names(
+        analyze_source(INGEST_SHARED_SUPPRESSED, relpath=INGEST_REL))
+    assert "unlocked-shared-state" in names(
+        analyze_source(INGEST_SHARED_SUPPRESSED, relpath=INGEST_REL,
+                       keep_suppressed=True))
+    # clean: the same mutation under the module lock
+    assert "unlocked-shared-state" not in names(
+        analyze_source(SHARED_LOCKED, relpath=INGEST_REL))
 
 
 # ---- telemetry-schema ----
