@@ -155,17 +155,23 @@ def main():
         "verbosity": -1,
         "metric": "auc",
     }
-    t0 = time.time()
-    ds = lgb.Dataset(X, label=y, params=params)  # params BEFORE construct: max_bin
-    ds.construct()                               # must reach the bin finder
-    t_bin = time.time() - t0
+    # count distinct jit lowerings across construct (which hosts the
+    # background AOT prewarm — the counter's patch is process-global, so the
+    # compile thread is included) + the first dispatched iteration: the
+    # compile-diet regression gauge that wall-clock compile_s can only hint at
+    import jax._src.test_util as jtu
+    with jtu.count_jit_and_pmap_lowerings() as n_lowerings:
+        t0 = time.time()
+        ds = lgb.Dataset(X, label=y, params=params)  # params BEFORE construct: max_bin
+        ds.construct()                               # must reach the bin finder
+        t_bin = time.time() - t0
 
-    booster = lgb.Booster(params=params, train_set=ds)
-    # warmup: compile + first iteration
-    t0 = time.time()
-    booster.update()
-    jax.block_until_ready(booster.raw_train_score())
-    t_compile = time.time() - t0
+        booster = lgb.Booster(params=params, train_set=ds)
+        # warmup: compile + first iteration
+        t0 = time.time()
+        booster.update()
+        jax.block_until_ready(booster.raw_train_score())
+        t_compile = time.time() - t0
 
     t0 = time.time()
     for _ in range(n_iters):
@@ -192,7 +198,8 @@ def main():
             "value": round(iters_per_sec, 4), "unit": "iters/sec",
             "vs_baseline": round(iters_per_sec / baseline_here, 4),
             "bin_s": round(t_bin, 2), "bin_phases": ds.construct_phases,
-            "compile_s": round(t_compile, 2), **compile_split,
+            "compile_s": round(t_compile, 2), "lowerings": n_lowerings[0],
+            **compile_split,
             "telemetry": _telemetry_snapshot()}))
         return
     prob = 1.0 / (1.0 + np.exp(-np.asarray(booster.raw_train_score())))
@@ -246,6 +253,7 @@ def main():
         # exceed the stream_s wall when the pipeline overlaps
         "bin_phases": ds.construct_phases,
         "compile_s": round(t_compile, 2),   # warmup wall: first update + barrier
+        "lowerings": n_lowerings[0],        # programs lowered through warmup
         **compile_split,
         "train_auc": round(auc, 4),
         **({"ref_auc": round(ref_auc, 4)} if ref_auc is not None else {}),

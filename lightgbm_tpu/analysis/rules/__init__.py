@@ -2,4 +2,4 @@
 ``core._REGISTRY``; each module holds one hazard class and documents the
 production incident it guards against (see docs/STATIC_ANALYSIS.md)."""
 from . import (atomic_write, dtype_drift, host_sync, nonfinite, params,  # noqa: F401
-               retrace, shared_state, telemetry)
+               retrace, shared_state, telemetry, unsharded_transfer)

@@ -1,0 +1,68 @@
+"""Rule: unsharded-transfer — bare ``device_put`` in mesh-scoped modules.
+
+The mesh-native data path (PR 6) keeps the binned matrix row-sharded from
+the first H2D copy: every chunk is ``device_put`` directly onto its owning
+shard's device and the global array is assembled with
+``make_array_from_single_device_arrays`` — nothing ever materializes on one
+chip. A ``jax.device_put(x)`` with no device/sharding argument silently
+lands the whole buffer on ``jax.devices()[0]``; inside the sharded ingest
+or the mesh utilities that is exactly the single-device bottleneck the
+row partition exists to avoid (and at the 100M-row bench scale it is an
+OOM, not just a slowdown).
+
+The rule is scoped to the modules that own mesh placement —
+``lightgbm_tpu/ingest.py`` and ``lightgbm_tpu/parallel/`` — where an
+unplaced transfer is always either a bug or a deliberate legacy
+single-device path. The latter is the suppression case:
+``# tpu-lint: disable=unsharded-transfer`` with a reason comment.
+Elsewhere (tests, serving, host-side utilities) a default placement is
+fine and the rule stays silent.
+"""
+from __future__ import annotations
+
+import ast
+
+from ..core import ModuleContext, Rule, register
+
+# modules that own mesh/shard placement: a transfer here must say where
+_SCOPED_SUFFIXES = ("lightgbm_tpu/ingest.py",)
+_SCOPED_DIRS = ("lightgbm_tpu/parallel/",)
+
+# keyword names that carry a placement (jax.device_put signature: the
+# second positional is `device`, accepting Device | Sharding | layout)
+_PLACEMENT_KWARGS = ("device", "sharding", "src")
+
+
+@register
+class UnshardedTransfer(Rule):
+    name = "unsharded-transfer"
+    severity = "error"
+    description = ("device_put without a device/sharding argument inside "
+                   "mesh-scoped modules (ingest.py, parallel/)")
+    rationale = ("a bare device_put lands the whole buffer on devices[0]; "
+                 "in the sharded ingest/mesh layer that recreates the "
+                 "single-chip bottleneck (OOM at 100M rows) the row "
+                 "partition exists to avoid")
+
+    def check_module(self, ctx: ModuleContext) -> None:
+        rp = ctx.relpath
+        if not (rp.endswith(_SCOPED_SUFFIXES)
+                or any(d in rp for d in _SCOPED_DIRS)):
+            return
+        for node in ast.walk(ctx.tree):
+            if not isinstance(node, ast.Call):
+                continue
+            f = node.func
+            name = f.attr if isinstance(f, ast.Attribute) else \
+                f.id if isinstance(f, ast.Name) else None
+            if name != "device_put":
+                continue
+            if len(node.args) >= 2:
+                continue   # positional device/sharding present
+            if any(kw.arg in _PLACEMENT_KWARGS for kw in node.keywords):
+                continue
+            ctx.report(self, node,
+                       "device_put without a device/sharding argument "
+                       "places the full buffer on jax.devices()[0]; pass "
+                       "the owning shard's device (or a NamedSharding), "
+                       "or suppress for a deliberate single-device path")

@@ -122,7 +122,9 @@ class Dataset:
         # filled by construct():
         self.mappers: List[BinMapper] = []
         self.feature_map: Optional[np.ndarray] = None
-        self.bins = None            # jnp uint8 [N, F_used]
+        self.bins = None            # jnp uint8 [N, F_used] ([N_pad, F_used]
+        #                             row-sharded when shard_plan is set)
+        self.shard_plan = None      # parallel.mesh.RowShardPlan or None
         self.num_bins_dev = None    # jnp i32 [F_used]
         self.na_bin_dev = None      # jnp i32 [F_used]
         self.missing_type_dev = None
@@ -301,6 +303,17 @@ class Dataset:
         self._derive_names(columns, raw.shape[1])
         num_bins, na_bin, mtypes, maxb = self._derive_meta()
         self._publish_meta(num_bins, na_bin, mtypes, maxb)
+        # mesh-native row sharding: the plan (pure metadata) is published
+        # BEFORE ingest so chunk routing, the background prewarm's sharded
+        # avals and the trainer's shard_map all agree on one shard grid
+        from .parallel.mesh import plan_row_sharding, resolve_num_shards
+        self.shard_plan = plan_row_sharding(
+            n_rows, resolve_num_shards(conf.num_shards),
+            axis_name=conf.mesh_axis)
+        if self.shard_plan is not None:
+            log.info(f"row-sharded ingest: {self.shard_plan.num_shards} "
+                     f"shards x {self.shard_plan.rows_per_shard} rows "
+                     f"(pad {self.shard_plan.pad_rows})")
         # shapes are now final: compile the fused train step in the
         # background while the pipeline below encodes/uploads the bulk rows
         from . import prewarm as _prewarm
@@ -309,7 +322,8 @@ class Dataset:
         bins_dev = stream_encode_upload(
             raw, mappers, self.bundle_meta, width=int(len(num_bins)),
             chunk_rows=conf.ingest_chunk_rows,
-            encode_threads=conf.encode_threads, phases=phases)
+            encode_threads=conf.encode_threads, phases=phases,
+            shard_plan=self.shard_plan)
         from . import binning as _binning
         phases["encoder"] = _binning.LAST_ENCODE_PATH
         _mark("stream_s")   # wall time of the overlapped pipeline
@@ -432,7 +446,11 @@ class Dataset:
         else:
             self.bins = jax.device_put(np.ascontiguousarray(bins_np))
         self._publish_meta(num_bins_np, na_bin_np, mtypes_np, maxb)
-        self._num_data = bins_np.shape[0]
+        # row-sharded bins carry shard-grid padding rows; num_data is the
+        # TRUE row count from the plan, never the padded device shape
+        self._num_data = (self.shard_plan.n_rows
+                          if self.shard_plan is not None
+                          else bins_np.shape[0])
         self._constructed = True
         if self.free_raw_data:
             self.raw_data = None
@@ -448,7 +466,9 @@ class Dataset:
         import pickle
         payload = {
             "magic": self._BIN_MAGIC,
-            "bins": np.asarray(self.bins),
+            # slice off shard-grid padding rows: the cache holds TRUE rows
+            # (reloads re-plan sharding for whatever mesh they run on)
+            "bins": np.asarray(self.bins)[: self._num_data],
             "num_bins": np.asarray(self.num_bins_dev),
             "na_bin_raw": np.asarray(self._na_bin_raw),
             "missing_type": np.asarray(self.missing_type_dev),
@@ -651,6 +671,10 @@ class Dataset:
         if other._num_data != self._num_data:
             log.fatal("Cannot add features from other Dataset with a "
                       "different number of rows")
+        if self.shard_plan is not None or \
+                getattr(other, "shard_plan", None) is not None:
+            log.fatal("add_features_from is not supported on row-sharded "
+                      "Datasets (construct with num_shards=1 first)")
         if self.bundle_meta is not None or other.bundle_meta is not None:
             from .efb import identity_meta, merge_bundle_meta
             a = self.bundle_meta or identity_meta(self.mappers)

@@ -173,6 +173,10 @@ _PARAMS: Dict[str, Tuple[Any, Tuple[str, ...]]] = {
     "grow_policy": ("depthwise", ()),      # depthwise | lossguide (leaf-wise)
     "hist_dtype": ("float32", ()),         # histogram accumulator dtype
     "mesh_axis": ("data", ()),             # mesh axis name for data-parallel sharding
+    # row shards for mesh-native data-parallel training: 0 = auto (all local
+    # devices on accelerator backends; 1 on the cpu backend where extra
+    # devices are virtual), 1 = force single-chip, k = shard over k devices
+    "num_shards": (0, ("data_shards",)),
     # ---- cold-start pipeline (new in this framework; see ingest.py/prewarm.py) ----
     # rows per streamed ingest chunk (encode -> H2D -> commit pipeline);
     # ~56 MB of uint8 bins at 28 features — big enough for full tunnel
@@ -327,6 +331,10 @@ class Config:
             log.fatal("ingest_chunk_rows must be >= 1")
         if self.encode_threads < 0:
             log.fatal("encode_threads must be >= 0 (0 = auto)")
+        if self.num_shards < 0:
+            log.fatal("num_shards must be >= 0 (0 = auto)")
+        if not self.mesh_axis:
+            log.fatal("mesh_axis must be a non-empty axis name")
         if self.network_retries < 1:
             log.fatal("network_retries must be >= 1")
 

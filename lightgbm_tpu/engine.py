@@ -207,7 +207,9 @@ def train(params: Dict[str, Any], train_set: Dataset,
                                     "boosting iterations completed").inc()
                 obs.METRICS.histogram("train_iter_seconds",
                                       "iteration wall time").observe(dt)
-                obs.memory.update_gauges(obs.METRICS)
+                obs.memory.update_gauges(
+                    obs.METRICS,
+                    shard_of=booster._gbdt.obs_shard_devices())
             # per-iteration wall clock (reference: gbdt.cpp:289 "%f seconds
             # elapsed, finished iteration %d" at every metric output interval)
             if conf.verbosity >= 1 and conf.metric_freq > 0 \
@@ -349,9 +351,11 @@ def _predict_via_trees(init_booster: Booster, dataset) -> np.ndarray:
     stacked["threshold_bin"] = tb
     from .models.tree import ensemble_max_depth, ensemble_path_tables
     dense = ensemble_path_tables(stacked, _np.asarray(dataset.na_bin_dev))
-    return P.ensemble_raw_scores(
+    out = P.ensemble_raw_scores(
         dense, stacked, dataset.bins, dataset.na_bin_dev, k,
         len(trees), avg=False, max_steps=ensemble_max_depth(stacked))
+    # row-sharded datasets carry shard-grid padding rows; scores are per TRUE row
+    return out[: dataset.num_data] if out.shape[0] != dataset.num_data else out
 
 
 def cv(params: Dict[str, Any], train_set: Dataset, num_boost_round: int = 100,

@@ -11,9 +11,20 @@ transfer (gpu_tree_learner.cpp). Here the same shape feeds the TPU:
 - **H2D** — one uploader thread ``jax.device_put``s each encoded chunk;
   the bounded queue in front of it keeps at most two chunks in flight
   (double buffering), so chunk i+1 transfers while chunk i commits,
-- **commit** — one thread folds each uploaded chunk into a single donated
+- **commit** — one thread folds each uploaded chunk into a donated
   device accumulator (``_set_rows``) and blocks for completion, which is
   what backpressures the whole pipeline to device speed.
+
+Mesh-native sharding: with a ``RowShardPlan`` (parallel/mesh.py) each chunk
+is routed to its OWNING shard — chunk boundaries are aligned to the shard
+grid (a chunk never spans two shards), the uploader device_puts straight to
+the shard's device, and the commit stage keeps one donated accumulator PER
+shard, so the full matrix never materializes on any single device. The
+per-shard buffers are stitched into one global row-sharded array with
+``jax.make_array_from_single_device_arrays`` at the end — zero copies,
+zero relayout, because the plan's contiguous row blocks are exactly the
+layout of ``NamedSharding(mesh, P(axis, None))``. Padding rows (shard grid
+round-up) stay zero; the trainer masks them with zero gradients/hessians.
 
 Every stage communicates over bounded queues: a full queue blocks the
 producer (backpressure), a ``None`` sentinel terminates each consumer, and
@@ -32,6 +43,7 @@ scope, same as serving.py and obs/.
 """
 from __future__ import annotations
 
+import functools
 import queue
 import threading
 import time
@@ -53,6 +65,25 @@ from .utils import log
 _set_rows = jax.jit(
     lambda acc, chunk, s0: jax.lax.dynamic_update_slice(acc, chunk, (s0, 0)),
     donate_argnums=0)
+
+
+@functools.lru_cache(maxsize=64)
+def _device_zeros_maker(shape, dtype, device):
+    """Cached jit wrapper producing zeros directly ON ``device`` — the cache
+    keeps one wrapper (and one trace) per (shape, dtype, device) across
+    Dataset constructions instead of rebuilding it per shard."""
+    from jax.sharding import SingleDeviceSharding
+    # the enclosing lru_cache IS the hoist: one wrapper per distinct
+    # (shape, dtype, device) key  # tpu-lint: disable=retrace-hazard
+    return jax.jit(lambda: jnp.zeros(shape, dtype),
+                   out_shardings=SingleDeviceSharding(device))
+
+
+def _device_zeros(shape, dtype, device):
+    """Allocate a zero buffer directly ON ``device`` — no host-side zeros
+    materialization and no transfer (a host np.zeros + device_put would cost
+    a full-buffer H2D per shard just to ship zeros)."""
+    return _device_zeros_maker(tuple(shape), jnp.dtype(dtype), device)()
 
 # stats of the most recent pipeline run (profiling surface for
 # scripts/profile_ingest.py and the bench); guarded: construct can run from
@@ -89,9 +120,12 @@ def overlap_efficiency(stage_spans, wall_s: float) -> float:
 
 def stream_encode_upload(raw, mappers, meta, *, width: int,
                          chunk_rows: int, encode_threads: int = 0,
-                         phases: Optional[Dict[str, Any]] = None):
+                         phases: Optional[Dict[str, Any]] = None,
+                         shard_plan=None):
     """Run the three-stage pipeline over ``raw`` [N, F_raw] and return the
-    device bin matrix [N, width] uint8.
+    device bin matrix: [N, width] uint8 on one device, or — with a
+    ``shard_plan`` (parallel/mesh.RowShardPlan) — a global
+    [n_padded, width] array row-sharded over the plan's mesh.
 
     ``meta`` is the (already planned) EFB bundle meta or None; bundling is
     applied per chunk inside the encode stage so the unbundled matrix never
@@ -104,20 +138,32 @@ def stream_encode_upload(raw, mappers, meta, *, width: int,
     if n == 0:
         return jnp.zeros((0, width), jnp.uint8)
     chunk_rows = max(1, int(chunk_rows))
-    offsets = list(range(0, n, chunk_rows))
-    threads = min(resolve_encode_threads(encode_threads), len(offsets))
+    if shard_plan is not None:
+        # chunk grid aligned to the shard grid: every chunk lies inside ONE
+        # shard's row block, so the uploader can target the owning device
+        # and commits stay single-device dynamic-update-slices
+        chunk_rows = min(chunk_rows, shard_plan.rows_per_shard)
+        tasks = []
+        for s in range(shard_plan.num_shards):
+            lo, hi = shard_plan.shard_rows_range(s)
+            tasks.extend((s, g0, min(g0 + chunk_rows, hi))
+                         for g0 in range(lo, hi, chunk_rows))
+    else:
+        tasks = [(None, g0, min(g0 + chunk_rows, n))
+                 for g0 in range(0, n, chunk_rows)]
+    threads = min(resolve_encode_threads(encode_threads), max(len(tasks), 1))
     tele = obs.enabled()
 
     work_q: "queue.Queue" = queue.Queue()
-    for ci, s0 in enumerate(offsets):
-        work_q.put((ci, s0))
+    for ci, (shard, g0, g1) in enumerate(tasks):
+        work_q.put((ci, shard, g0, g1))
     # encoded chunks awaiting H2D: one being transferred + one ready is the
     # double buffer; a deeper queue would only raise host memory pressure
     enc_q: "queue.Queue" = queue.Queue(maxsize=2)
     # uploaded chunks awaiting commit
     dev_q: "queue.Queue" = queue.Queue(maxsize=2)
-    state: Dict[str, Any] = {"acc": None, "exc": None, "encode_s": 0.0,
-                             "h2d_s": 0.0, "commit_s": 0.0}
+    state: Dict[str, Any] = {"acc": None, "accs": {}, "exc": None,
+                             "encode_s": 0.0, "h2d_s": 0.0, "commit_s": 0.0}
     lock = threading.Lock()
 
     def _fail(e: BaseException) -> None:
@@ -128,7 +174,7 @@ def stream_encode_upload(raw, mappers, meta, *, width: int,
     def _encode_loop():
         while True:
             try:
-                ci, s0 = work_q.get_nowait()
+                ci, shard, g0, g1 = work_q.get_nowait()
             except queue.Empty:
                 return
             with lock:
@@ -136,14 +182,14 @@ def stream_encode_upload(raw, mappers, meta, *, width: int,
                     continue   # drain remaining work items without encoding
             try:
                 t0 = time.perf_counter()
-                cb = bin_data(raw[s0: s0 + chunk_rows], mappers).bins
+                cb = bin_data(raw[g0:g1], mappers).bins
                 if meta is not None:
                     cb = apply_bundles(cb, meta)
                 cb = np.ascontiguousarray(cb)
                 dt = time.perf_counter() - t0
                 with lock:
                     state["encode_s"] += dt
-                enc_q.put((ci, s0, cb, dt))
+                enc_q.put((ci, shard, g0, cb, dt))
             except BaseException as e:   # surfaced after join
                 _fail(e)
 
@@ -157,9 +203,17 @@ def stream_encode_upload(raw, mappers, meta, *, width: int,
                 if state["exc"] is not None:
                     continue   # keep draining so encoder puts never block
             try:
-                ci, s0, cb, enc_dt = item
+                ci, shard, g0, cb, enc_dt = item
                 t0 = time.perf_counter()
-                dev = jax.device_put(cb)
+                if shard is not None:
+                    # straight to the owning shard's device — the global
+                    # matrix never exists on any single chip
+                    dev = jax.device_put(cb, shard_plan.devices[shard])
+                else:
+                    # single-accumulator path: follows the ambient default
+                    # device on purpose (the plan-less contract predates the
+                    # mesh)  # tpu-lint: disable=unsharded-transfer
+                    dev = jax.device_put(cb)
                 # block for transfer completion: h2d_s must measure the copy,
                 # not the async enqueue — this thread exists so the wait
                 # overlaps encode(i+1) and commit(i-1)
@@ -167,7 +221,7 @@ def stream_encode_upload(raw, mappers, meta, *, width: int,
                 dt = time.perf_counter() - t0
                 with lock:
                     state["h2d_s"] += dt
-                dev_q.put((ci, s0, dev, cb.shape[0], enc_dt, dt))
+                dev_q.put((ci, shard, g0, dev, cb.shape[0], enc_dt, dt))
             except BaseException as e:
                 _fail(e)
 
@@ -180,14 +234,29 @@ def stream_encode_upload(raw, mappers, meta, *, width: int,
                 if state["exc"] is not None:
                     continue
             try:
-                ci, s0, dev, rows, enc_dt, h2d_dt = item
+                ci, shard, g0, dev, rows, enc_dt, h2d_dt = item
                 t0 = time.perf_counter()
-                if state["acc"] is None:
+                if shard is not None:
                     with lock:
-                        state["acc"] = jnp.zeros((n, width), dev.dtype)
-                with lock:
-                    acc = _set_rows(state["acc"], dev, jnp.int32(s0))
-                    state["acc"] = acc
+                        acc = state["accs"].get(shard)
+                    if acc is None:
+                        # donated per-shard accumulator, allocated lazily ON
+                        # its device (zero rows beyond the shard's real rows
+                        # are the padding the trainer masks)
+                        acc = _device_zeros(
+                            (shard_plan.rows_per_shard, width), dev.dtype,
+                            shard_plan.devices[shard])
+                    local0 = g0 - shard * shard_plan.rows_per_shard
+                    acc = _set_rows(acc, dev, jnp.int32(local0))
+                    with lock:
+                        state["accs"][shard] = acc
+                else:
+                    if state["acc"] is None:
+                        with lock:
+                            state["acc"] = jnp.zeros((n, width), dev.dtype)
+                    with lock:
+                        acc = _set_rows(state["acc"], dev, jnp.int32(g0))
+                        state["acc"] = acc
                 # block: the donated accumulate must finish before the next
                 # donation, and the wait here is the pipeline's backpressure
                 acc.block_until_ready()   # tpu-lint: disable=host-sync-in-jit
@@ -205,6 +274,11 @@ def stream_encode_upload(raw, mappers, meta, *, width: int,
                     obs.emit("ingest_chunk", chunk=int(ci), rows=int(rows),
                              encode_s=float(enc_dt), h2d_s=float(h2d_dt),
                              commit_s=float(dt), depth=int(depth))
+                    if shard is not None:
+                        obs.emit("mesh_shard_commit", shard=int(shard),
+                                 rows=int(rows), bytes=int(rows * width),
+                                 chunk=int(ci), h2d_s=float(h2d_dt),
+                                 commit_s=float(dt))
             except BaseException as e:
                 _fail(e)
 
@@ -237,9 +311,11 @@ def stream_encode_upload(raw, mappers, meta, *, width: int,
     stats = {"encode_s": round(state["encode_s"], 3),
              "h2d_s": round(state["h2d_s"], 3),
              "commit_s": round(state["commit_s"], 3),
-             "encode_threads": threads, "chunks": len(offsets),
+             "encode_threads": threads, "chunks": len(tasks),
              "chunk_rows": chunk_rows, "wall_s": round(wall, 3),
-             "overlap_efficiency": round(eff, 3)}
+             "overlap_efficiency": round(eff, 3),
+             "shards": (shard_plan.num_shards if shard_plan is not None
+                        else 1)}
     with _STATS_LOCK:
         LAST_INGEST_STATS.clear()
         LAST_INGEST_STATS.update(stats)
@@ -249,7 +325,20 @@ def stream_encode_upload(raw, mappers, meta, *, width: int,
                                   "encode_threads", "chunks")}
         phases["overlap_efficiency"] = stats["overlap_efficiency"]
     log.debug("ingest pipeline: %s", stats)
-    return state["acc"]
+    if shard_plan is None:
+        return state["acc"]
+    # stitch the per-shard buffers into ONE global row-sharded array — no
+    # copy: every buffer already lives on its owning device and the plan's
+    # contiguous blocks are the NamedSharding layout
+    arrays = []
+    for s in range(shard_plan.num_shards):
+        a = state["accs"].get(s)
+        if a is None:   # shard holds only padding rows (n < num_shards * rps)
+            a = _device_zeros((shard_plan.rows_per_shard, width), jnp.uint8,
+                              shard_plan.devices[s])
+        arrays.append(a)
+    return jax.make_array_from_single_device_arrays(
+        (shard_plan.n_padded, width), shard_plan.sharding(2), arrays)
 
 
 def last_stats() -> Dict[str, Any]:

@@ -80,6 +80,8 @@ class DART(GBDT):
                 tree_dev.default_left, tree_dev.left_child, tree_dev.right_child,
                 tree_dev.num_leaves, bins, na_bin, max_steps)
             delta = take_small(tree_dev.leaf_value, leaf) * sign
+            if delta.shape[0] != score.shape[0]:
+                delta = delta[: score.shape[0]]   # row-shard padding rows
             if k == 1:
                 return score + delta
             return score.at[:, cls].add(delta)

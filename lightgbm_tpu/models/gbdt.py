@@ -266,12 +266,25 @@ class GBDT:
         if _nproc > 1:
             from ..parallel.fence import consistency_fence
             consistency_fence(config, train_set)
+        # mesh-native row sharding: when Dataset.construct built the binned
+        # matrix over a RowShardPlan, data-parallel training is the DEFAULT
+        # regardless of tree_learner (the plan only exists when
+        # num_shards resolved > 1; on accelerator backends auto = all
+        # devices, the jax_graft analog of the reference's rank-per-machine
+        # DataParallelTreeLearner being implied by num_machines)
+        plan = getattr(train_set, "shard_plan", None)
+        self._plan = plan
         self._dp = (config.tree_learner in ("data", "data_parallel", "voting")
-                    and len(jax.devices()) > 1)
+                    and len(jax.devices()) > 1) or plan is not None
         # feature-parallel (#25): full data replicated, features sharded,
         # split election via compiler-inserted collectives
         self._fp = (config.tree_learner in ("feature", "feature_parallel")
                     and len(jax.devices()) > 1)
+        if self._fp and plan is not None:
+            log.fatal("tree_learner=feature cannot train on a row-sharded "
+                      "Dataset; construct with num_shards=1")
+        if self._fp:
+            self._dp = False
         if self._fp:
             from ..parallel.feature_parallel import (make_feature_mesh,
                                                      shard_features_once)
@@ -295,12 +308,25 @@ class GBDT:
             self._cegb_dev = None
         if self._dp:
             from ..parallel.mesh import make_mesh, pad_rows_to_devices, shard_rows
-            self._mesh = make_mesh()
-            nd = int(self._mesh.devices.size)
-            bins_np = np.asarray(train_set.bins)
-            padded, self._n_orig = pad_rows_to_devices(bins_np, nd)
-            self._bins_dp = shard_rows(jnp.asarray(padded), self._mesh)
-            self._pad_rows = padded.shape[0] - self._n_orig
+            if plan is not None:
+                # Dataset.construct already committed each ingest chunk to
+                # its owning shard and stitched the padded [N_pad, F] matrix
+                # over the plan's mesh — adopt it as-is. _bins_dp resolves
+                # lazily at first dispatch because the background prewarm
+                # trainer is constructed while the bins are still streaming.
+                self._mesh = plan.mesh
+                self._n_orig = plan.n_rows
+                self._pad_rows = plan.pad_rows
+                self._bins_dp = None
+            else:
+                # legacy path (explicit tree_learner=data on an unsharded
+                # Dataset): pad + re-shard through the host
+                self._mesh = make_mesh()
+                nd = int(self._mesh.devices.size)
+                bins_np = np.asarray(train_set.bins)
+                padded, self._n_orig = pad_rows_to_devices(bins_np, nd)
+                self._bins_dp = shard_rows(jnp.asarray(padded), self._mesh)
+                self._pad_rows = padded.shape[0] - self._n_orig
             if (self._cegb_dev is not None
                     and self._cegb_dev.data_used.shape[0] > 1):
                 # lazy bitset rows pad + shard with the data (padded rows
@@ -309,14 +335,21 @@ class GBDT:
                 if self._pad_rows:
                     du = jnp.pad(du, ((0, self._pad_rows), (0, 0)))
                 self._cegb_dev = self._cegb_dev._replace(
-                    data_used=shard_rows(du, self._mesh))
-            log.info(f"data-parallel tree learner over {nd} devices")
+                    data_used=shard_rows(du, self._mesh,
+                                         self._mesh.axis_names[0]))
+            log.info(f"data-parallel tree learner over "
+                     f"{int(self._mesh.devices.size)} devices "
+                     f"(axis '{self._mesh.axis_names[0]}', "
+                     f"{'mesh-native' if plan is not None else 'host-resharded'})")
+            if not quiet:
+                self._emit_hist_allreduce_probe()
         # background AOT compile handed over by Dataset.construct (prewarm.py);
         # resolved lazily at the first _fused_step dispatch so the compile
         # keeps overlapping whatever runs between construction and training.
         # quiet=True IS the prewarm trainer — it must not adopt itself.
         self._prewarm_handle = (getattr(train_set, "_prewarm", None)
-                                if not (quiet or self._dp or self._fp)
+                                if not (quiet or self._fp
+                                        or (self._dp and plan is None))
                                 else None)
         self._step_aot = None   # adopted Compiled executable (auto path)
         self._aot_dispatches = 0
@@ -388,9 +421,6 @@ class GBDT:
             ("hist_dtype", "float32",
              "histograms accumulate in f32 on TPU; other dtypes are not "
              "implemented"),
-            ("mesh_axis", "data",
-             "custom mesh axis names are not plumbed through shard_map "
-             "specs yet; the data axis is named 'data'"),
         ]
         for name, default, why in checks:
             if getattr(config, name, default) != default:
@@ -848,6 +878,65 @@ class GBDT:
         col = jnp.take(score, cls, axis=1) + delta
         return jax.lax.dynamic_update_index_in_dim(score, col, cls, 1)
 
+    def _dp_bins(self):
+        """Row-sharded [N_pad, F] bins for the data-parallel step.
+
+        Mesh-native plan datasets hand their already-sharded matrix over
+        directly; resolution is lazy because the background prewarm trainer
+        is constructed while the ingest pipeline is still streaming chunks
+        (train_set.bins does not exist yet at __init__ time there)."""
+        if self._bins_dp is None:
+            self._bins_dp = self.train_set.bins
+        return self._bins_dp
+
+    def obs_shard_devices(self):
+        """device label -> shard index for the active data mesh, or None when
+        not data-parallel. Lets obs.memory label device watermarks per
+        shard."""
+        if not getattr(self, "_dp", False) \
+                or getattr(self, "_mesh", None) is None:
+            return None
+        # keyed by device id string — the label obs.memory.sample() uses
+        return {str(d.id): i for i, d in enumerate(self._mesh.devices.flat)}
+
+    def _emit_hist_allreduce_probe(self) -> None:
+        """One timed histogram-shaped psum over the data mesh at setup.
+
+        The in-step psum runs inside the fused jit where per-op wall time is
+        invisible from the host, so the `hist_allreduce` event records a
+        host-timed probe of the SAME collective on the same mesh with the
+        real histogram shape [3, F, max_bin] f32 — the cost model input for
+        PERF_NOTES' psum-vs-allgather table."""
+        from .. import obs
+        if not obs.enabled():
+            return
+        try:
+            import time as _time
+
+            from jax.sharding import PartitionSpec as PS
+
+            from ..parallel.mesh import replicate, shard_map_compat
+            mesh = self._mesh
+            axis = mesh.axis_names[0]
+            f = int(getattr(self.train_set, "_num_features_used", None)
+                    or self.train_set.num_features or 1)
+            shape = (3, f, int(self.gp.max_bin))
+            x = replicate(jnp.ones(shape, jnp.float32), mesh)
+            # one-shot probe per trainer: the wrapper is built, timed, and
+            # dropped here by design  # tpu-lint: disable=retrace-hazard
+            fn = jax.jit(shard_map_compat(
+                lambda a: jax.lax.psum(a, axis), mesh=mesh,
+                in_specs=(PS(),), out_specs=PS(), check_vma=False))
+            fn(x).block_until_ready()   # compile outside the timing
+            t0 = _time.perf_counter()
+            fn(x).block_until_ready()   # tpu-lint: disable=host-sync-in-jit
+            dt = _time.perf_counter() - t0
+            obs.emit("hist_allreduce",
+                     shards=int(mesh.devices.size),
+                     bytes=int(np.prod(shape)) * 4, psum_s=float(dt))
+        except Exception as e:   # a failed probe must never block training
+            log.debug("hist_allreduce probe failed: %s", e)
+
     def _fused_step(self, grad, hess):
         custom = grad is not None
         key = "_step_custom" if custom else "_step_auto"
@@ -869,7 +958,7 @@ class GBDT:
         shrink = 1.0 if self.average_output else self.learning_rate
         cegb_in = self._cegb_dev if self._cegb_dev is not None else dummy
         if self._dp:
-            bins_arg, nb_arg, na_arg = (self._bins_dp, ts.num_bins_dev,
+            bins_arg, nb_arg, na_arg = (self._dp_bins(), ts.num_bins_dev,
                                         ts.na_bin_dev)
         elif self._fp:
             bins_arg, nb_arg, na_arg = (self._fp_bins, self._fp_num_bins,
@@ -1179,7 +1268,8 @@ class GBDT:
                 if depthwise:
                     grow_fn = self._grow_fn()   # honors lean_ft (pool budget)
                 tree_dev, leaf_id = grow_tree_dp(
-                    self._bins_dp, gw, hw, cw, ts.num_bins_dev, ts.na_bin_dev,
+                    self._dp_bins(), gw, hw, cw, ts.num_bins_dev,
+                    ts.na_bin_dev,
                     fmask, self.gp, self._mesh, grow_fn=grow_fn,
                     bundle=self._bundle_dev,
                     qseed=jnp.int32(self.iter_ * k + cls))
@@ -1301,6 +1391,8 @@ class GBDT:
                 tree_dev.default_left, tree_dev.left_child, tree_dev.right_child,
                 tree_dev.num_leaves, ts.bins, ts.na_bin_dev, max_steps)
             delta = take_small(tree_dev.leaf_value, leaf)
+            if delta.shape[0] != self.train_score.shape[0]:
+                delta = delta[: self.train_score.shape[0]]   # shard padding
             if k == 1:
                 self.train_score = self.train_score - delta
             else:
@@ -1372,6 +1464,8 @@ class GBDT:
                 tree_dev.default_left, tree_dev.left_child, tree_dev.right_child,
                 tree_dev.num_leaves, bins, self.train_set.na_bin_dev, max_steps)
             delta = take_small(tree_dev.leaf_value, leaf)
+            if delta.shape[0] != out.shape[0]:
+                delta = delta[: out.shape[0]]   # row-shard padding rows
             out = out + delta if k == 1 else out.at[:, cls].add(delta)
         if self.average_output and self.models_dev:
             out = out / (len(self.models_dev) // k)
@@ -1539,7 +1633,8 @@ class GBDT:
             if self._dp and fields["data_used"].shape[0] > 1:
                 from ..parallel.mesh import shard_rows
                 fields["data_used"] = shard_rows(fields["data_used"],
-                                                 self._mesh)
+                                                 self._mesh,
+                                                 self._mesh.axis_names[0])
             self._cegb_dev = type(self._cegb_dev)(**fields)
         q = getattr(self, "_pending_leafcounts_q", None)
         if q is not None:

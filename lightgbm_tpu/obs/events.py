@@ -59,6 +59,14 @@ EVENT_SCHEMAS: Dict[str, Tuple[Dict[str, Any], Dict[str, Any]]] = {
     "ingest_chunk": ({"chunk": int, "rows": int},
                      {"encode_s": _NUM, "h2d_s": _NUM, "commit_s": _NUM,
                       "depth": int}),
+    # a chunk was committed into its owning row shard's donated accumulator
+    # (mesh-native sharded ingest, ingest.py): shard id + payload size
+    "mesh_shard_commit": ({"shard": int, "rows": int, "bytes": int},
+                          {"chunk": int, "h2d_s": _NUM, "commit_s": _NUM}),
+    # host-timed probe of the histogram psum over the data mesh (the in-step
+    # psum is fused inside the jitted tree grower where per-op wall time is
+    # invisible; the probe runs the same collective/shape at trainer setup)
+    "hist_allreduce": ({"shards": int, "bytes": int, "psum_s": _NUM}, {}),
     # background AOT compile lifecycle (prewarm.py): started -> compiled ->
     # adopted, or skipped/miss/error with a reason; duration_s is the
     # compile time (compiled/error), or the join-barrier wait (adopted)

@@ -42,10 +42,16 @@ def sample() -> List[Dict[str, Any]]:
     return out
 
 
-def update_gauges(registry) -> List[Dict[str, Any]]:
+def update_gauges(registry, shard_of: Optional[Dict[str, int]] = None
+                  ) -> List[Dict[str, Any]]:
     """Fold one sample into gauges on ``registry``; returns the raw sample.
     ``bytes_in_use`` is point-in-time (set); peaks are high-watermarked
-    (set_max) so periodic sampling converges on the true run maximum."""
+    (set_max) so periodic sampling converges on the true run maximum.
+
+    ``shard_of`` (device label -> shard index, from the trainer's data mesh)
+    additionally maintains a per-shard peak watermark
+    ``shard_memory_peak_bytes{shard=...}`` so imbalance across row shards is
+    visible directly, without joining device ids against the mesh by hand."""
     readings = sample()
     for rec in readings:
         dev = rec["device"]
@@ -58,6 +64,18 @@ def update_gauges(registry) -> List[Dict[str, Any]]:
                 g.set_max(rec[k])
             else:
                 g.set(rec[k])
+        if shard_of and "peak_bytes_in_use" in rec:
+            # sample() labels by device id; the mesh maps by device string —
+            # accept either key so both backends resolve
+            shard = shard_of.get(rec["device"])
+            if shard is None:
+                shard = next((s for d, s in shard_of.items()
+                              if rec["device"] in d), None)
+            if shard is not None:
+                registry.gauge("shard_memory_peak_bytes",
+                               "per-row-shard device memory high watermark",
+                               shard=str(shard)
+                               ).set_max(rec["peak_bytes_in_use"])
     return readings
 
 

@@ -162,6 +162,64 @@ def _monotone_child_bounds(sp: SplitParams, f: int, res, feat, sel,
     return leaf_min2, leaf_max2
 
 
+def _run_level_schedule(state, level, L, max_levels, n_unroll, MAX_SLOTS,
+                        slot_floor):
+    """Bucketed level schedule shared by both depthwise growers: run
+    ``level(state, SLOTS, lvl)`` for lvl in [0, max_levels) with the slot
+    width growing as min(MAX_SLOTS, max(2**lvl, slot_floor)).
+
+    Consecutive levels with the SAME width are fused into one
+    ``lax.while_loop`` so the level body is traced (and XLA-compiled) once
+    per DISTINCT width instead of once per depth — with the pallas slot
+    floor at 32 and L=255 that is 3 traced bodies ({32, 64, 127}) instead
+    of 10, which is most of the BENCH_r05 compile_s regression. The loop
+    form is bit-identical to the old per-level ``lax.cond`` unroll: the
+    loop guard is the same early-exit predicate the conds used (once a
+    level selects nothing, ``last`` stays 0 and every later group runs
+    zero iterations), and the level index reaches the body as a traced
+    i32 either way (it only feeds ``jax.random.fold_in``).
+    """
+    widths = [min(MAX_SLOTS, max(2 ** k, slot_floor))
+              for k in range(n_unroll)]
+    groups = []   # [width, first level, one-past-last level]
+    for k, w in enumerate(widths):
+        if groups and groups[-1][0] == w:
+            groups[-1][2] = k + 1
+        else:
+            groups.append([w, k, k + 1])
+    if max_levels > n_unroll:
+        # unbalanced-growth tail: full width, merged with the last unrolled
+        # group when that group already runs at MAX_SLOTS
+        if groups and groups[-1][0] == MAX_SLOTS:
+            groups[-1][2] = max_levels
+        else:
+            groups.append([MAX_SLOTS, n_unroll, max_levels])
+    last_sel = jnp.int32(1)
+    for w, k0, k1 in groups:
+        if k1 - k0 == 1:
+            # single level at this width: cond and while_loop both trace the
+            # body exactly once; cond skips the carry plumbing
+            state, last_sel = jax.lax.cond(
+                (last_sel > 0) & (state.tree.num_leaves < L),
+                lambda st, _w=w, _k=k0: level(st, _w, jnp.int32(_k)),
+                lambda st: (st, jnp.int32(0)),
+                state)
+            continue
+
+        def cond(carry, _k1=k1):
+            st, lvl, last = carry
+            return (lvl < _k1) & (last > 0) & (st.tree.num_leaves < L)
+
+        def body(carry, _w=w):
+            st, lvl, _ = carry
+            st2, num_sel = level(st, _w, lvl)
+            return st2, lvl + 1, num_sel
+
+        state, _, last_sel = jax.lax.while_loop(
+            cond, body, (state, jnp.int32(k0), last_sel))
+    return state
+
+
 @partial(jax.jit, static_argnames=("gp",))
 def grow_tree_depthwise(bins: jnp.ndarray, g: jnp.ndarray, h: jnp.ndarray,
                         c: jnp.ndarray, num_bins: jnp.ndarray,
@@ -546,35 +604,16 @@ def grow_tree_depthwise(bins: jnp.ndarray, g: jnp.ndarray, h: jnp.ndarray,
     # exact 2^k widths. Selection is unchanged under padding — at level k
     # the frontier is <= 2^k <= padded S, so `rank < min(budget, SLOTS)`
     # binds identically and the grown tree is bit-identical.
+    # Early exit is built into the schedule guard: once a level selects no
+    # splits OR the leaf budget is exhausted, the tree is finished and every
+    # remaining full-data pass is skipped. The budget check matters for
+    # balanced growth: a tree that fills num_leaves=255 exactly at level 8
+    # would otherwise pay one more full-width hist pass just to select
+    # nothing (~25% of whole-tree cost, measured at 10M rows).
     slot_floor = _SLOT_FLOOR if use_pallas else 1
     n_unroll = min(max_levels, max(1, math.ceil(math.log2(max(L - 1, 2)))) + 1)
-    last_sel = jnp.int32(1)
-    for k in range(n_unroll):
-        slots_k = min(MAX_SLOTS, max(2 ** k, slot_floor))
-        # early exit: once a level selects no splits OR the leaf budget is
-        # exhausted, the tree is finished — skip the remaining unrolled
-        # full-data passes. The budget check matters for balanced growth: a
-        # tree that fills num_leaves=255 exactly at level 8 would otherwise
-        # pay one more full-width (S=129) hist pass just to select nothing
-        # (~25% of whole-tree cost, measured at 10M rows)
-        state, last_sel = jax.lax.cond(
-            (last_sel > 0) & (state.tree.num_leaves < L),
-            lambda st, _s=slots_k, _k=k: level(st, _s, jnp.int32(_k)),
-            lambda st: (st, jnp.int32(0)),
-            state)
-
-    if max_levels > n_unroll:
-        def cond(carry):
-            st, lvl, last = carry
-            return (lvl < max_levels) & (last > 0) & (st.tree.num_leaves < L)
-
-        def body(carry):
-            st, lvl, _ = carry
-            st2, num_sel = level(st, MAX_SLOTS, lvl)
-            return st2, lvl + 1, num_sel
-
-        state, _, _ = jax.lax.while_loop(
-            cond, body, (state, jnp.int32(n_unroll), last_sel))
+    state = _run_level_schedule(state, level, L, max_levels, n_unroll,
+                                MAX_SLOTS, slot_floor)
 
     if gp.quant:
         # leaf renewal from EXACT sums (quantized-training paper: splits
@@ -902,29 +941,11 @@ def grow_tree_depthwise_lean(bins: jnp.ndarray, g, h, c, num_bins, na_bin,
 
     n_unroll = min(max_levels,
                    max(1, math.ceil(math.log2(max(L - 1, 2)))) + 1)
-    last_sel = jnp.int32(1)
+    # floored like the default grower: fewer distinct slot widths -> fewer
+    # compiled kernel variants, identical selection (see _run_level_schedule)
     slot_floor = _SLOT_FLOOR if use_pallas else 1
-    for k in range(n_unroll):
-        # floored like the default grower: fewer distinct slot widths ->
-        # fewer compiled kernel variants, identical selection (see above)
-        slots_k = min(MAX_SLOTS, max(2 ** k, slot_floor))
-        state, last_sel = jax.lax.cond(
-            (last_sel > 0) & (state.tree.num_leaves < L),
-            lambda st, _s=slots_k, _k=k: level(st, _s, jnp.int32(_k)),
-            lambda st: (st, jnp.int32(0)),
-            state)
-    if max_levels > n_unroll:
-        def cond(carry):
-            st, lvl, last = carry
-            return (lvl < max_levels) & (last > 0) & (st.tree.num_leaves < L)
-
-        def body(carry):
-            st, lvl, _ = carry
-            st2, num_sel = level(st, MAX_SLOTS, lvl)
-            return st2, lvl + 1, num_sel
-
-        state, _, _ = jax.lax.while_loop(
-            cond, body, (state, jnp.int32(n_unroll), last_sel))
+    state = _run_level_schedule(state, level, L, max_levels, n_unroll,
+                                MAX_SLOTS, slot_floor)
 
     if gp.quant:
         # leaf renewal from EXACT sums (same epilogue as the default grower)

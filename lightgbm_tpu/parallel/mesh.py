@@ -8,7 +8,8 @@ XLA collectives ride ICI/DCN automatically.
 """
 from __future__ import annotations
 
-from typing import Optional, Sequence
+import dataclasses
+from typing import List, Optional, Sequence
 
 import jax
 import numpy as np
@@ -17,6 +18,85 @@ from jax.sharding import Mesh, NamedSharding, PartitionSpec as P
 from ..utils import log
 
 DATA_AXIS = "data"
+
+
+@dataclasses.dataclass(frozen=True)
+class RowShardPlan:
+    """Row partition of an [N, ...] matrix over a 1-D device mesh.
+
+    The plan is pure metadata (mesh + row arithmetic) so it can be derived
+    BEFORE the binned matrix exists — Dataset.construct publishes it ahead of
+    the streamed ingest so chunk routing, the background AOT prewarm and the
+    trainer's shard_map all agree on one grid. Rows are blocked contiguously:
+    shard ``s`` owns global rows ``[s * rows_per_shard, (s+1) * rows_per_shard)``
+    which is exactly how ``NamedSharding(mesh, P(axis, None))`` lays out the
+    leading axis, so per-shard buffers assemble into the global array with
+    ``jax.make_array_from_single_device_arrays`` and zero relayout.
+    """
+    mesh: Mesh
+    axis_name: str
+    num_shards: int
+    n_rows: int            # true (unpadded) row count
+    rows_per_shard: int    # ceil(n_rows / num_shards)
+
+    @property
+    def n_padded(self) -> int:
+        return self.num_shards * self.rows_per_shard
+
+    @property
+    def pad_rows(self) -> int:
+        return self.n_padded - self.n_rows
+
+    @property
+    def devices(self) -> List:
+        return list(self.mesh.devices.flat)
+
+    def sharding(self, ndim: int = 2) -> NamedSharding:
+        """Leading-axis row sharding for an ndim-dimensional array."""
+        return NamedSharding(
+            self.mesh, P(self.axis_name, *([None] * (ndim - 1))))
+
+    def shard_rows_range(self, s: int):
+        """Global [lo, hi) of REAL rows owned by shard ``s`` (hi <= n_rows;
+        hi == lo for shards that hold only padding)."""
+        lo = min(s * self.rows_per_shard, self.n_rows)
+        hi = min((s + 1) * self.rows_per_shard, self.n_rows)
+        return lo, hi
+
+
+def resolve_num_shards(requested: int) -> int:
+    """Resolve the ``num_shards`` knob (0 = auto) to a concrete shard count.
+
+    Auto shards across every local device on accelerator backends — the
+    mesh-native data-parallel path is the DEFAULT whenever
+    ``jax.device_count() > 1`` on real chips. On the ``cpu`` backend extra
+    devices are virtual (``--xla_force_host_platform_device_count``, used by
+    the test suite to emulate a mesh on one host), so auto stays single-shard
+    there and CPU sharding must be requested explicitly.
+    """
+    nd = jax.device_count()
+    if requested and requested > 0:
+        if requested > nd:
+            log.warning(f"num_shards={requested} exceeds the {nd} available "
+                        "devices; clamping")
+        return max(1, min(int(requested), nd))
+    try:
+        platform = jax.devices()[0].platform
+    except Exception:
+        return 1
+    return nd if (nd > 1 and platform != "cpu") else 1
+
+
+def plan_row_sharding(n_rows: int, num_shards: int,
+                      axis_name: str = DATA_AXIS) -> Optional[RowShardPlan]:
+    """Build the row-shard plan, or None when one shard (single-chip path)."""
+    if num_shards <= 1 or n_rows <= 0:
+        return None
+    mesh = make_mesh(num_shards, axis_name=axis_name)
+    rps = -(-n_rows // num_shards)   # ceil
+    return RowShardPlan(mesh=mesh, axis_name=axis_name,
+                        num_shards=num_shards, n_rows=int(n_rows),
+                        rows_per_shard=int(rps))
 
 
 def shard_map_compat(f, *, mesh, in_specs, out_specs, check_vma=False):

@@ -24,7 +24,10 @@ to plain jit dispatch on any mismatch. The join in ``adopt`` is the barrier
 before first dispatch the pipeline design calls for.
 
 Scope: the serial single-process tree learner with a built-in objective
-(plain gbdt boosting). Everything else — dp/fp sharding, GOSS's custom-grad
+(plain gbdt boosting), INCLUDING its mesh-native row-sharded form (the
+lowering then runs against sharded avals — a dataset-published RowShardPlan
+fixes the padded shapes and the NamedSharding before ingest starts).
+Everything else — explicit data/voting/feature learners, GOSS's custom-grad
 step, dart's reweighting — skips the prewarm and compiles at first dispatch
 exactly as before. ``prewarm=0`` is the kill switch.
 """
@@ -92,24 +95,46 @@ def step_spec(gbdt) -> Dict[str, Any]:
         "forced": gbdt._forced_dev is not None,
         "dp": bool(gbdt._dp),
         "fp": bool(gbdt._fp),
+        # mesh-native row sharding shapes the program (shard_map + psum over
+        # the plan's mesh); shard count 0 = unsharded
+        "shards": (int(gbdt._plan.num_shards)
+                   if getattr(gbdt, "_plan", None) is not None else 0),
         "conf": {k: getattr(conf, k, None) for k in _SPEC_KEYS},
     }
 
 
 def step_avals(gbdt):
-    """ShapeDtypeStructs matching GBDT._fused_step's serial-path argument
-    construction exactly (order and dtypes included)."""
+    """ShapeDtypeStructs matching GBDT._fused_step's argument construction
+    exactly (order and dtypes included).
+
+    With a mesh-native RowShardPlan the bins aval is [n_padded, f] and
+    carries the plan's NamedSharding — lowering against the sharded aval is
+    what makes the AOT executable match the row-sharded dispatch arguments,
+    so cold-start still hides behind the (sharded) ingest. CEGB's row-wise
+    lazy bitset is likewise already sharded on the trainer and its aval
+    copies the live array's sharding."""
     import jax
     ts = gbdt.train_set
     n, f = int(ts.num_data), int(ts.num_features)
     k = gbdt.num_tree_per_iteration
+    plan = getattr(gbdt, "_plan", None)
     S = jax.ShapeDtypeStruct
     score = S((n,) if k == 1 else (n, k), np.float32)
     sc_f = S((), np.float32)
-    cegb = (jax.tree_util.tree_map(lambda a: S(a.shape, a.dtype),
-                                   gbdt._cegb_dev)
+
+    def _arr_aval(a):
+        if plan is not None and getattr(a, "sharding", None) is not None:
+            return S(a.shape, a.dtype, sharding=a.sharding)
+        return S(a.shape, a.dtype)
+
+    cegb = (jax.tree_util.tree_map(_arr_aval, gbdt._cegb_dev)
             if gbdt._cegb_dev is not None else sc_f)
-    return (S((n, f), np.uint8),        # bins
+    if plan is not None:
+        bins_aval = S((plan.n_padded, f), np.uint8,
+                      sharding=plan.sharding(2))
+    else:
+        bins_aval = S((n, f), np.uint8)
+    return (bins_aval,                  # bins
             S((f,), np.int32),          # num_bins
             S((f,), np.int32),          # na_bin
             score,                      # train score

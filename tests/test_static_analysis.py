@@ -424,6 +424,61 @@ def test_nonfinite_literal_fires_and_clean():
     assert "nonfinite-policy-literal" not in names(analyze_source(ok))
 
 
+# ---- unsharded-transfer ----
+
+UNSHARDED_BAD = """
+import jax
+
+def commit(chunk):
+    return jax.device_put(chunk)
+"""
+
+UNSHARDED_SUPPRESSED = """
+import jax
+
+def commit(chunk):
+    # legacy single-accumulator path  # tpu-lint: disable=unsharded-transfer
+    return jax.device_put(chunk)
+"""
+
+UNSHARDED_CLEAN = """
+import jax
+
+def commit(chunk, plan, shard, sharding):
+    a = jax.device_put(chunk, plan.devices[shard])
+    b = jax.device_put(chunk, device=plan.devices[shard])
+    return a, jax.device_put(chunk, sharding=sharding), b
+"""
+
+MESH_REL = "lightgbm_tpu/ingest.py"
+
+
+def test_unsharded_transfer_fires_in_mesh_scope():
+    assert "unsharded-transfer" in names(
+        analyze_source(UNSHARDED_BAD, relpath=MESH_REL))
+    assert "unsharded-transfer" in names(
+        analyze_source(UNSHARDED_BAD, relpath="lightgbm_tpu/parallel/mesh.py"))
+
+
+def test_unsharded_transfer_out_of_scope_silent():
+    # a default placement outside the mesh layer is fine (serving, tests)
+    assert "unsharded-transfer" not in names(
+        analyze_source(UNSHARDED_BAD, relpath="lightgbm_tpu/engine.py"))
+
+
+def test_unsharded_transfer_suppressed():
+    assert "unsharded-transfer" not in names(
+        analyze_source(UNSHARDED_SUPPRESSED, relpath=MESH_REL))
+    kept = analyze_source(UNSHARDED_SUPPRESSED, relpath=MESH_REL,
+                          keep_suppressed=True)
+    assert "unsharded-transfer" in names(kept)
+
+
+def test_unsharded_transfer_clean_with_placement():
+    assert "unsharded-transfer" not in names(
+        analyze_source(UNSHARDED_CLEAN, relpath=MESH_REL))
+
+
 # ---------------------------------------------------------------------------
 # suppression / baseline machinery
 
