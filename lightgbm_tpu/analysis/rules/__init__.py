@@ -1,5 +1,6 @@
 """tpu-lint rule battery. Importing this package registers every rule with
 ``core._REGISTRY``; each module holds one hazard class and documents the
 production incident it guards against (see docs/STATIC_ANALYSIS.md)."""
-from . import (atomic_write, dtype_drift, host_sync, nonfinite, params,  # noqa: F401
-               retrace, shared_state, telemetry, unsharded_transfer)
+from . import (atomic_write, device_errors, dtype_drift, host_sync,  # noqa: F401
+               nonfinite, params, retrace, shared_state, telemetry,
+               unsharded_transfer)

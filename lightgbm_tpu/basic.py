@@ -318,12 +318,18 @@ class Dataset:
         # background while the pipeline below encodes/uploads the bulk rows
         from . import prewarm as _prewarm
         self._prewarm = _prewarm.maybe_start(conf, self)
-        from .ingest import stream_encode_upload
-        bins_dev = stream_encode_upload(
+        from .ingest import stream_with_recovery
+        bins_dev, plan_used, _rows_used = stream_with_recovery(
             raw, mappers, self.bundle_meta, width=int(len(num_bins)),
             chunk_rows=conf.ingest_chunk_rows,
             encode_threads=conf.encode_threads, phases=phases,
-            shard_plan=self.shard_plan)
+            shard_plan=self.shard_plan, policy=conf.on_device_fault)
+        if plan_used is not self.shard_plan:
+            # OOM-adaptive degradation changed the shard grid mid-ingest; the
+            # published plan must match the matrix the trainer will adopt
+            # (a now-stale prewarm spec simply misses adoption and the step
+            # compiles at first dispatch)
+            self.shard_plan = plan_used
         from . import binning as _binning
         phases["encoder"] = _binning.LAST_ENCODE_PATH
         _mark("stream_s")   # wall time of the overlapped pipeline

@@ -201,6 +201,13 @@ _PARAMS: Dict[str, Tuple[Any, Tuple[str, ...]]] = {
     "network_retries": (3, ()),
     # fault-injection spec (utils/faults.py), e.g. "snapshot_write:2"
     "faults": ("", ("fault_spec",)),
+    # recovery policy for device-level faults (XLA RESOURCE_EXHAUSTED during
+    # ingest commit / fused-step dispatch, injected device chaos points):
+    # fatal = re-raise immediately (reference CHECK semantics) | reshard =
+    # halve ingest chunks, then re-plan the row sharding over more devices
+    # when available | fallback_single = degrade to the single-device path
+    # with a warning. Every recovery emits a `device_fault` telemetry event.
+    "on_device_fault": ("reshard", ("device_fault_policy",)),
     # ---- observability (new in this framework; see lightgbm_tpu/obs/) ----
     # structured telemetry: schema'd events + metrics around the hot paths;
     # LGBMTPU_TELEMETRY=0/1 env overrides the param in either direction
@@ -337,6 +344,9 @@ class Config:
             log.fatal("mesh_axis must be a non-empty axis name")
         if self.network_retries < 1:
             log.fatal("network_retries must be >= 1")
+        if self.on_device_fault not in ("fatal", "reshard", "fallback_single"):
+            log.fatal("on_device_fault must be one of fatal|reshard|"
+                      f"fallback_single, got {self.on_device_fault!r}")
 
     def to_dict(self) -> Dict[str, Any]:
         out = {name: getattr(self, name) for name in _PARAMS}

@@ -100,8 +100,13 @@ def train(params: Dict[str, Any], train_set: Dataset,
                 resumed = True
                 log.info(f"resumed from {payload.model_path} "
                          f"(iteration {payload.iteration})")
+                _plan = getattr(train_set, "shard_plan", None)
                 obs.emit("resume", iteration=int(payload.iteration),
-                         path=payload.model_path, source="snapshot")
+                         path=payload.model_path, source="snapshot",
+                         num_shards=(int(_plan.num_shards)
+                                     if _plan is not None else 1),
+                         snapshot_shards=int(
+                             payload.meta.get("num_shards", 1) or 1))
             except ValueError as e:
                 log.warning(f"cannot resume from {payload.model_path}: {e}; "
                             "training from scratch")

@@ -55,7 +55,7 @@ import numpy as np
 
 from . import obs
 from .binning import bin_data
-from .utils import log
+from .utils import faults, log
 
 # accumulate rows into ONE preallocated device buffer via a donated
 # dynamic-update (peak device memory 1x + in-flight chunks; a concatenate of
@@ -205,6 +205,9 @@ def stream_encode_upload(raw, mappers, meta, *, width: int,
             try:
                 ci, shard, g0, cb, enc_dt = item
                 t0 = time.perf_counter()
+                # chaos point: simulated device OOM on the H2D transfer
+                # (raises the real XLA RESOURCE_EXHAUSTED error type)
+                faults.fault_point("device_put_oom")
                 if shard is not None:
                     # straight to the owning shard's device — the global
                     # matrix never exists on any single chip
@@ -237,6 +240,9 @@ def stream_encode_upload(raw, mappers, meta, *, width: int,
                 ci, shard, g0, dev, rows, enc_dt, h2d_dt = item
                 t0 = time.perf_counter()
                 if shard is not None:
+                    # chaos point: a chunk's fold into its owning shard's
+                    # donated accumulator failed (lost chip / dead buffer)
+                    faults.fault_point("shard_commit")
                     with lock:
                         acc = state["accs"].get(shard)
                     if acc is None:
@@ -345,3 +351,106 @@ def last_stats() -> Dict[str, Any]:
     """Copy of the most recent pipeline run's stage breakdown."""
     with _STATS_LOCK:
         return dict(LAST_INGEST_STATS)
+
+
+# OOM-adaptive degradation bounds (stream_with_recovery): at most this many
+# chunk halvings before escalating to the policy action, and a hard cap on
+# total recovery attempts so a persistent fault can never loop forever
+MAX_CHUNK_HALVINGS = 3
+MAX_RECOVERY_ATTEMPTS = 8
+
+
+def _grow_plan(plan):
+    """Re-plan the row sharding over more devices (double, clamped to the
+    device count); None when the plan cannot grow."""
+    if plan is None:
+        return None
+    nd = jax.device_count()
+    if plan.num_shards >= nd:
+        return None
+    from .parallel.mesh import plan_row_sharding
+    return plan_row_sharding(plan.n_rows, min(nd, plan.num_shards * 2),
+                             axis_name=plan.axis_name)
+
+
+def stream_with_recovery(raw, mappers, meta, *, width: int, chunk_rows: int,
+                         encode_threads: int = 0,
+                         phases: Optional[Dict[str, Any]] = None,
+                         shard_plan=None, policy: str = "reshard",
+                         sleep=time.sleep):
+    """:func:`stream_encode_upload` with OOM-adaptive degradation.
+
+    A device-level fault during the pipeline (XLA ``RESOURCE_EXHAUSTED`` on
+    the H2D transfer or commit, or an injected device chaos point — see
+    ``utils.faults.is_device_fault``) is recovered per the ``on_device_fault``
+    policy instead of propagating:
+
+    1. **halve the chunk** — up to :data:`MAX_CHUNK_HALVINGS` times; smaller
+       chunks shrink both the host staging buffer and the in-flight transfer,
+       the usual cure for a transient allocator squeeze,
+    2. then policy ``reshard`` — re-plan the row sharding over MORE devices
+       (each shard's resident slice shrinks proportionally),
+       or policy ``fallback_single`` — drop the plan and drain through the
+       single-device path with a warning,
+    3. policy ``fatal`` (or a non-device fault) re-raises immediately —
+       reference CHECK semantics.
+
+    Each recovery emits a schema-registered ``device_fault`` event and sleeps
+    a deterministic backoff. Returns ``(bins_dev, plan, chunk_rows)`` — the
+    plan/chunk size actually used, which the caller must adopt (the published
+    Dataset plan and the prewarm spec both key on them).
+    """
+    from .utils.retry import backoff_delays
+
+    plan = shard_plan
+    rows = max(1, int(chunk_rows))
+    halvings = 0
+    attempt = 0
+    delays = list(backoff_delays(MAX_RECOVERY_ATTEMPTS + 1,
+                                 base_delay=0.05, max_delay=1.0))
+    while True:
+        try:
+            bins = stream_encode_upload(
+                raw, mappers, meta, width=width, chunk_rows=rows,
+                encode_threads=encode_threads, phases=phases,
+                shard_plan=plan)
+            return bins, plan, rows
+        except BaseException as e:
+            if policy == "fatal" or not faults.is_device_fault(e):
+                raise
+            attempt += 1
+            if attempt > MAX_RECOVERY_ATTEMPTS:
+                raise
+            point = faults.classify_point(e)
+            before = plan.num_shards if plan is not None else 1
+            after = before
+            if halvings < MAX_CHUNK_HALVINGS and rows > 1:
+                rows = max(1, rows // 2)
+                halvings += 1
+                action = "halve_chunk"
+                log.warning(
+                    f"device fault during ingest ({type(e).__name__}: {e}); "
+                    f"halving chunk to {rows} rows and retrying "
+                    f"({halvings}/{MAX_CHUNK_HALVINGS})")
+            elif policy == "reshard" and (grown := _grow_plan(plan)) is not None:
+                plan = grown
+                after = plan.num_shards
+                action = "reshard"
+                log.warning(
+                    f"device fault persists after chunk halving; re-planning "
+                    f"row sharding {before} -> {after} shards")
+            elif policy == "fallback_single" and plan is not None:
+                plan = None
+                after = 1
+                action = "fallback_single"
+                log.warning(
+                    "device fault persists after chunk halving; draining to "
+                    "the single-device ingest path (mesh training disabled "
+                    "for this dataset)")
+            else:
+                raise
+            obs.emit("device_fault", point=point, policy=policy,
+                     action=action, error=f"{type(e).__name__}: {e}",
+                     attempt=attempt, chunk_rows=int(rows),
+                     shards_before=int(before), shards_after=int(after))
+            sleep(delays[min(attempt - 1, len(delays) - 1)])

@@ -23,13 +23,28 @@ from ..ops.gather import take_small
 from ..ops.grow import GrowParams, TreeArrays, grow_tree
 from ..ops.split import SplitParams
 from ..ops import predict as P
-from ..utils import log
+from ..utils import faults, log
 from .tree import Tree, stack_trees
 
 K_EPSILON = 1e-15
 # score magnitude cap for nonfinite_policy=clip (far beyond any sane boosted
 # score, small enough that f32 sums of clipped values stay finite)
 _NF_CLIP = 1e30
+
+
+def _host_gather(x) -> np.ndarray:
+    """Host copy of a possibly-sharded device array. With a process-local
+    mesh ``np.asarray`` already gathers across the local devices; on a
+    multi-host mesh the shards are allgathered first so the writer rank's
+    snapshot holds the FULL (unsharded) state."""
+    try:
+        fully = x.sharding.is_fully_addressable
+    except Exception:
+        fully = True
+    if fully:
+        return np.asarray(x)
+    from jax.experimental import multihost_utils
+    return np.asarray(multihost_utils.process_allgather(x, tiled=True))
 
 
 class GBDT:
@@ -341,6 +356,13 @@ class GBDT:
                      f"{int(self._mesh.devices.size)} devices "
                      f"(axis '{self._mesh.axis_names[0]}', "
                      f"{'mesh-native' if plan is not None else 'host-resharded'})")
+            if plan is not None and not quiet:
+                # fail fast BEFORE step 0: device liveness + shard-plan/
+                # config consistency (locally, and across ranks when
+                # multi-process) — a mismatched mesh hangs mid-collective
+                # otherwise, with no diff to debug from
+                from ..parallel.fence import mesh_preflight
+                mesh_preflight(config, train_set, plan)
             if not quiet:
                 self._emit_hist_allreduce_probe()
         # background AOT compile handed over by Dataset.construct (prewarm.py);
@@ -934,7 +956,9 @@ class GBDT:
             obs.emit("hist_allreduce",
                      shards=int(mesh.devices.size),
                      bytes=int(np.prod(shape)) * 4, psum_s=float(dt))
-        except Exception as e:   # a failed probe must never block training
+        # measurement-only best-effort path: the training psum has its own
+        # recovery in _fused_step, a failed probe must never block training
+        except Exception as e:   # tpu-lint: disable=swallowed-device-error
             log.debug("hist_allreduce probe failed: %s", e)
 
     def _fused_step(self, grad, hess):
@@ -971,27 +995,59 @@ class GBDT:
                 hess if custom else dummy,
                 jnp.float32(shrink), jnp.int32(self.iter_),
                 jnp.float32(self.iter_ + 1), cegb_in)
-        trees = None
-        if not custom and self._step_aot is not None:
-            try:
-                # prewarmed executables are dispatched directly — AOT
-                # compilation never enters the jit wrapper's cache, so going
-                # through the wrapper would compile the same program twice
-                trees, new_score, cegb_out, ok = self._step_aot(*args)
-                self._aot_dispatches += 1
-            except TypeError as e:
-                # aval drift vs the lowering (e.g. an objective swapped in
-                # after prewarm): compile at dispatch like before
-                log.warning("prewarmed step rejected the training arguments "
-                            f"({e}); compiling at dispatch")
-                self._step_aot = None
-        if trees is None:
+        def _dispatch():
+            if self._dp:
+                # chaos point: host side of the fused-step dispatch whose
+                # traced body carries the per-level histogram psum — inside
+                # the retried callable so a recovery attempt re-hits it
+                faults.fault_point("hist_allreduce")
+            if not custom and self._step_aot is not None:
+                try:
+                    # prewarmed executables are dispatched directly — AOT
+                    # compilation never enters the jit wrapper's cache, so
+                    # going through the wrapper would compile the same
+                    # program twice
+                    out = self._step_aot(*args)
+                    self._aot_dispatches += 1
+                    return out
+                except TypeError as e:
+                    # aval drift vs the lowering (e.g. an objective swapped
+                    # in after prewarm): compile at dispatch like before
+                    log.warning("prewarmed step rejected the training "
+                                f"arguments ({e}); compiling at dispatch")
+                    self._step_aot = None
             fn = getattr(self, key, None)
             if fn is None:
                 fn = self._build_fused_step(custom)
                 setattr(self, key, fn)
-            trees, new_score, cegb_out, ok = fn(*args)
+            out = fn(*args)
             self._obs_track_compiles(key, fn)
+            return out
+
+        policy = self.config.on_device_fault
+        try:
+            trees, new_score, cegb_out, ok = _dispatch()
+        except BaseException as e:
+            # a step-time device fault (RESOURCE_EXHAUSTED from allocator
+            # fragmentation, or an injected device chaos point) is usually
+            # transient: under a non-fatal policy retry the SAME dispatch
+            # with backoff before giving up (the matrix cannot be re-sharded
+            # mid-train — ingest-time faults are where the plan adapts)
+            if policy == "fatal" or not faults.is_device_fault(e):
+                raise
+            from .. import obs
+            from ..utils.retry import call_with_backoff
+            obs.emit("device_fault",
+                     point=faults.classify_point(e, default="hist_allreduce"),
+                     policy=policy, action="retry",
+                     error=f"{type(e).__name__}: {e}", attempt=1)
+            log.warning(f"device fault during fused-step dispatch "
+                        f"({type(e).__name__}: {e}); retrying")
+            trees, new_score, cegb_out, ok = call_with_backoff(
+                _dispatch, attempts=max(2, int(self.config.network_retries)),
+                base_delay=0.05, max_delay=1.0,
+                should_retry=faults.is_device_fault,
+                name="fused_step dispatch")
         k = self.num_tree_per_iteration
         if k > 8:
             # scan path returns class-stacked TreeArrays; unstack in ONE
@@ -1550,9 +1606,15 @@ class GBDT:
             "learning_rate": float(self.learning_rate),
             "has_init_score": bool(self._has_init_score),
             "has_bag_mask": self._bag_mask is not None,
+            # shard count the snapshot was taken at — informational (the
+            # state below is stored UNSHARDED and unpadded, so resume onto
+            # any shard count k' re-shards on load; num_shards/mesh_axis are
+            # deliberately absent from _RESUME_FP_KEYS)
+            "num_shards": (self._plan.num_shards
+                           if self._plan is not None else 1),
             "fingerprint": self._resume_fingerprint(),
         }
-        arrays["train_score"] = np.asarray(self.train_score)
+        arrays["train_score"] = _host_gather(self.train_score)
         # snapshot state is serialized in f64 on purpose: resume must be
         # bit-lossless for host-side quantities (init scores, RNG gauss
         # carry), and these arrays go to disk, never to the device
@@ -1579,7 +1641,14 @@ class GBDT:
                     [np.asarray(getattr(t, f)) for t in host])
         if self._cegb_dev is not None:
             for f in self._cegb_dev._fields:
-                arrays[f"cegb_{f}"] = np.asarray(getattr(self._cegb_dev, f))
+                a = _host_gather(getattr(self._cegb_dev, f))
+                if (f == "data_used" and a.shape[0] > 1
+                        and getattr(self, "_dp", False)):
+                    # data_used lives padded + row-sharded on the mesh; the
+                    # snapshot stores the TRUE rows only so a resume onto a
+                    # different shard count re-pads for its own grid
+                    a = a[: int(self._n_orig)]
+                arrays[f"cegb_{f}"] = a
         self._extra_resume_state(arrays, meta)
         return arrays, meta
 
@@ -1600,6 +1669,11 @@ class GBDT:
             raise ValueError(
                 f"snapshot score shape {arrays['train_score'].shape} != "
                 f"trainer score shape {tuple(self.train_score.shape)}")
+        snap_k = int(meta.get("num_shards", 0) or 0)
+        cur_k = self._plan.num_shards if self._plan is not None else 1
+        if snap_k and snap_k != cur_k:
+            log.info(f"resuming a snapshot taken at {snap_k} shard(s) onto "
+                     f"{cur_k} shard(s); sharded state re-shards on load")
         self.iter_ = int(meta["iter"])
         self.learning_rate = float(meta["learning_rate"])
         self._has_init_score = bool(meta["has_init_score"])
@@ -1630,11 +1704,18 @@ class GBDT:
         if self._cegb_dev is not None and "cegb_feature_used" in arrays:
             fields = {f: jnp.asarray(arrays[f"cegb_{f}"])
                       for f in self._cegb_dev._fields}
-            if self._dp and fields["data_used"].shape[0] > 1:
-                from ..parallel.mesh import shard_rows
-                fields["data_used"] = shard_rows(fields["data_used"],
-                                                 self._mesh,
-                                                 self._mesh.axis_names[0])
+            if fields["data_used"].shape[0] > 1:
+                # stored at TRUE rows (pre-format-2 snapshots stored the
+                # writer's padded grid — slice back to true rows first),
+                # then pad + shard for THIS trainer's grid, which may be a
+                # different shard count than the writer's
+                du = fields["data_used"][: int(self.train_set.num_data)]
+                if self._dp:
+                    from ..parallel.mesh import shard_rows
+                    if self._pad_rows:
+                        du = jnp.pad(du, ((0, self._pad_rows), (0, 0)))
+                    du = shard_rows(du, self._mesh, self._mesh.axis_names[0])
+                fields["data_used"] = du
             self._cegb_dev = type(self._cegb_dev)(**fields)
         q = getattr(self, "_pending_leafcounts_q", None)
         if q is not None:

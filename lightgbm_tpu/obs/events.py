@@ -43,8 +43,9 @@ EVENT_SCHEMAS: Dict[str, Tuple[Dict[str, Any], Dict[str, Any]]] = {
     "compile": ({"what": str, "cache_size": int},
                 {"duration_s": _NUM, "key": str}),
     "snapshot_write": ({"iteration": int, "path": str, "duration_s": _NUM},
-                       {"kept": int}),
-    "resume": ({"iteration": int, "path": str}, {"source": str}),
+                       {"kept": int, "num_shards": int}),
+    "resume": ({"iteration": int, "path": str},
+               {"source": str, "num_shards": int, "snapshot_shards": int}),
     # a non-finite guard fired (gradients/scores/eval values)
     "nonfinite_guard": ({"where": str, "policy": str},
                         {"iteration": int, "action": str}),
@@ -76,6 +77,19 @@ EVENT_SCHEMAS: Dict[str, Tuple[Dict[str, Any], Dict[str, Any]]] = {
                    {"error": str, "delay_s": _NUM}),
     "consistency_fence": ({"processes": int, "ok": bool},
                           {"mismatched_fields": int}),
+    # a device-level fault (real or injected XLA RESOURCE_EXHAUSTED, or a
+    # device chaos point) was caught and a recovery action taken per the
+    # on_device_fault policy: action is one of halve_chunk / reshard /
+    # fallback_single / retry / fatal
+    "device_fault": ({"point": str, "policy": str, "action": str},
+                     {"error": str, "attempt": int, "chunk_rows": int,
+                      "shards_before": int, "shards_after": int}),
+    # pre-step-0 mesh validation (parallel/fence.mesh_preflight): device
+    # liveness probe + shard-plan/config consistency, locally and (multi-
+    # process) across ranks
+    "mesh_preflight": ({"shards": int, "ok": bool},
+                       {"devices": int, "mismatched_fields": int,
+                        "error": str}),
 }
 
 

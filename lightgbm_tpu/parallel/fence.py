@@ -37,6 +37,9 @@ FENCE_CONFIG_FIELDS = (
     "cegb_penalty_feature_coupled", "cegb_penalty_feature_lazy",
     "drop_rate", "skip_drop", "max_drop", "uniform_drop",
     "xgboost_dart_mode", "drop_seed", "top_rate", "other_rate",
+    # mesh topology: ranks that disagree on the shard grid dispatch
+    # incompatible collectives (mismatched psum shapes hang, they don't err)
+    "num_shards", "mesh_axis", "on_device_fault",
 )
 
 
@@ -76,6 +79,13 @@ def fence_items(config, train_set=None) -> List[Tuple[str, bytes]]:
     items.append(("data.num_features",
                   repr(getattr(train_set, "num_features", None)
                        if train_set is not None else None).encode()))
+    plan = getattr(train_set, "shard_plan", None) if train_set is not None \
+        else None
+    items.append(("data.shard_plan",
+                  b"none" if plan is None
+                  else repr((plan.axis_name, int(plan.num_shards),
+                             int(plan.n_rows),
+                             int(plan.rows_per_shard))).encode()))
     return items
 
 
@@ -120,4 +130,95 @@ def consistency_fence(config, train_set=None, raise_on_mismatch: bool = True
     if raise_on_mismatch:
         log.fatal(msg)
     log.warning(msg)
+    return False
+
+
+def probe_device_liveness(devices) -> List[str]:
+    """One tiny H2D put + readback per device; a chip that was lost after
+    jax initialized (or never came up) fails here in milliseconds instead of
+    hanging the first collective. Returns one line per dead device."""
+    import jax
+    dead: List[str] = []
+    probe = np.ones((1,), np.float32)
+    for d in devices:
+        try:
+            x = jax.device_put(probe, d)
+            # the sync IS the probe: liveness means the transfer completed
+            x.block_until_ready()
+            if float(np.asarray(x)[0]) != 1.0:
+                dead.append(f"  {d}: probe readback mismatch")
+        except Exception as e:   # a dead device is data here, not a failure
+            dead.append(f"  {d}: {type(e).__name__}: {e}")
+    return dead
+
+
+def mesh_preflight(config, train_set, plan,
+                   raise_on_mismatch: bool = True) -> bool:
+    """Validate the mesh BEFORE step 0: device liveness + shard-plan/config
+    consistency, locally and (multi-process) across ranks.
+
+    A bad mesh does not fail loudly on its own — a dead chip or a rank with
+    a different shard grid dispatches a collective that simply never
+    completes. This fence turns that mid-train hang into an immediate
+    LightGBMError with a per-field diff. Trivially True when ``plan`` is
+    None (single-chip path has no mesh to validate).
+    """
+    import jax
+    from .. import obs
+    if plan is None:
+        return True
+    problems: List[str] = []
+    axis = getattr(plan, "axis_name", None)
+    if axis != config.mesh_axis:
+        problems.append(f"  plan.axis_name: plan={axis!r} "
+                        f"config.mesh_axis={config.mesh_axis!r}")
+    devices = list(getattr(plan, "devices", []))
+    k = int(getattr(plan, "num_shards", 0))
+    if k != len(devices):
+        problems.append(f"  plan.num_shards: plan={k} "
+                        f"mesh devices={len(devices)}")
+    try:
+        nd = jax.device_count()
+    except Exception:
+        nd = len(devices)
+    if k > nd:
+        problems.append(f"  plan.num_shards: plan={k} exceeds "
+                        f"jax.device_count()={nd}")
+    rps = int(getattr(plan, "rows_per_shard", 0))
+    n_rows = int(getattr(plan, "n_rows", 0))
+    if k > 0 and rps != -(-n_rows // k):
+        problems.append(f"  plan.rows_per_shard: plan={rps} "
+                        f"expected ceil({n_rows}/{k})={-(-n_rows // k)}")
+    ts_n = getattr(train_set, "num_data", None) if train_set is not None \
+        else None
+    if ts_n is not None and int(ts_n) != n_rows:
+        problems.append(f"  plan.n_rows: plan={n_rows} "
+                        f"train_set.num_data={int(ts_n)}")
+    problems.extend(probe_device_liveness(devices))
+    nproc = 1
+    fence_ok = True
+    if not problems:
+        try:
+            nproc = jax.process_count()
+        except Exception:
+            nproc = 1
+        if nproc > 1:
+            # cross-rank: every rank must hold the same config + mappers +
+            # shard plan (fence_items includes data.shard_plan); digests
+            # allgather even when the state disagrees
+            fence_ok = consistency_fence(config, train_set,
+                                         raise_on_mismatch=raise_on_mismatch)
+    ok = fence_ok and not problems
+    obs.emit("mesh_preflight", shards=int(k), ok=ok,
+             devices=len(devices), mismatched_fields=len(problems))
+    if ok:
+        log.info(f"mesh preflight passed: {k} shard(s) over {len(devices)} "
+                 f"live device(s), {nproc} process(es)")
+        return True
+    if problems:
+        msg = ("mesh preflight FAILED before step 0 — the first collective "
+               "would hang, not error. Problems:\n" + "\n".join(problems))
+        if raise_on_mismatch:
+            log.fatal(msg)
+        log.warning(msg)
     return False

@@ -204,6 +204,10 @@ def maybe_start(conf, dataset) -> Optional[PrewarmHandle]:
     def _worker():
         t0 = time.perf_counter()
         try:
+            # chaos point: a failed background compile must degrade to
+            # compile-at-dispatch (adoption miss), never break training
+            from .utils import faults
+            faults.fault_point("prewarm_compile")
             from .models.gbdt import GBDT
             from .objectives import create_objective
             objective = create_objective(conf.objective, conf)

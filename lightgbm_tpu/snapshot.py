@@ -21,6 +21,16 @@ fault injection).
 by parsing before returning it, so a snapshot truncated by a crash (possible
 only with non-atomic external writes — our own writes are all-or-nothing) is
 skipped with a warning, never loaded.
+
+Sharded runs: a snapshot is written ONCE per run, by the writer rank
+(:func:`is_writer_rank`), and the state sidecar holds the UNSHARDED view —
+``GBDT.get_resume_state`` host-gathers row-sharded arrays and strips mesh
+padding before they reach this module, and the resume fingerprint
+deliberately excludes ``num_shards``/``mesh_axis``.  A snapshot taken at
+shard count k therefore resumes onto ANY shard count k′ (including the
+single-chip path): ``set_resume_state`` re-pads and re-shards for the live
+trainer's own grid on load.  tests/test_zz_mesh_faults.py proves
+kill-and-resume byte-identity at k=2, k=8, and across k=8 → k=2.
 """
 from __future__ import annotations
 
@@ -164,8 +174,12 @@ def write_snapshot(booster, directory: str, iteration: int, keep: int = 3,
                       name=f"snapshot write (iteration {iteration})")
     _update_manifest(directory, iteration, keep)
     from . import obs
+    shards = 1
+    if booster._gbdt is not None and arrays is not None:
+        shards = int(meta.get("num_shards", 1) or 1)
     obs.emit("snapshot_write", iteration=int(iteration), path=model_path,
-             duration_s=time.perf_counter() - t0, kept=int(keep))
+             duration_s=time.perf_counter() - t0, kept=int(keep),
+             num_shards=shards)
     if obs.enabled():
         obs.METRICS.counter("snapshot_writes", "snapshots written").inc()
     return model_path

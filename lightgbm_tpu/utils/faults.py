@@ -1,9 +1,8 @@
 """Fault-injection harness.
 
-Named fault points are compiled into the hot paths of this package
-(``snapshot_write``, ``mapper_allgather``, ``dist_init``, ``tree_update``)
-and are inert unless armed. Arming happens via the ``LGBMTPU_FAULTS`` env var
-or the ``faults`` parameter, with the spec syntax::
+Named fault points are compiled into the hot paths of this package and are
+inert unless armed. Arming happens via the ``LGBMTPU_FAULTS`` env var or the
+``faults`` parameter, with the spec syntax::
 
     LGBMTPU_FAULTS="snapshot_write:2,mapper_allgather:1"
 
@@ -12,7 +11,42 @@ then it succeeds; ``mapper_allgather`` fails once.  A count of ``-1`` (or
 ``*``) fails forever — that is how the kill-and-resume tests simulate a
 process crash at a chosen iteration (``tree_update:0`` arms nothing;
 ``tree_update@5`` skips 5 hits then fails forever, i.e. "crash at the 6th
-boosting iteration").
+boosting iteration").  Unknown point names REJECT at arm time with the list
+of known points — a typo'd spec that silently arms nothing would make a
+chaos test pass without injecting anything.
+
+Fault-point registry (every name accepted in a spec):
+
+========================  ===================================================
+point                     fires in
+========================  ===================================================
+``snapshot_write``        utils/atomic_io.py — between the temp-file write
+                          and the atomic rename (the crash window the atomic
+                          protocol exists for); snapshot.py retries through it
+``mapper_allgather``      parallel/dist_data.py — the bin-mapper allgather
+                          during distributed bin finding
+``dist_init``             parallel/mesh.init_distributed — the
+                          jax.distributed bootstrap (retried with backoff)
+``tree_update``           engine.train — top of each boosting iteration
+                          (kill-and-resume crash simulation)
+``shard_commit``          ingest.py commit stage — before a chunk folds into
+                          its owning shard's donated accumulator
+``device_put_oom``        ingest.py H2D stage — before the chunk transfer;
+                          raises the REAL XLA ``RESOURCE_EXHAUSTED`` error
+                          type (simulated device OOM), so product catch
+                          paths match on the exception they see in prod
+``hist_allreduce``        models/gbdt.py — host side of the fused-step
+                          dispatch on the data mesh (the in-step histogram
+                          psum's dispatch site)
+``prewarm_compile``       prewarm.py — inside the background AOT compile
+                          worker (a failed prewarm must degrade to
+                          compile-at-dispatch, never break training)
+========================  ===================================================
+
+The last four are the DEVICE-level chaos points (:data:`DEVICE_FAULT_POINTS`)
+driving the mesh fault-tolerance layer: :func:`is_device_fault` classifies
+both their injected errors and real XLA ``RESOURCE_EXHAUSTED`` failures, and
+the ``on_device_fault`` policy (config.py) decides the recovery.
 
 The harness exists so the retry / atomic-write / resume machinery can be
 *proven* under failure in CPU-fast tests instead of trusted on faith; the
@@ -29,7 +63,18 @@ from . import log
 ENV_VAR = "LGBMTPU_FAULTS"
 
 KNOWN_POINTS = ("snapshot_write", "mapper_allgather", "dist_init",
-                "tree_update")
+                "tree_update", "shard_commit", "hist_allreduce",
+                "device_put_oom", "prewarm_compile")
+
+# chaos points that simulate DEVICE failures (OOM, lost chip, dead
+# collective): their injected errors classify as device faults and route
+# through the on_device_fault recovery policy instead of plain propagation
+DEVICE_FAULT_POINTS = ("shard_commit", "hist_allreduce", "device_put_oom",
+                       "prewarm_compile")
+
+# points whose injector raises the real XLA RESOURCE_EXHAUSTED error type
+# instead of FaultInjected (see _oom_error)
+_OOM_POINTS = ("device_put_oom",)
 
 _lock = threading.Lock()
 # name -> [skip_remaining, fail_remaining]; fail_remaining < 0 = fail forever
@@ -45,6 +90,70 @@ class FaultInjected(RuntimeError):
         super().__init__(f"injected fault at '{point}' (hit #{hit})")
         self.point = point
         self.hit = hit
+
+
+class SimulatedOomError(RuntimeError):
+    """Fallback OOM injector error when the jaxlib runtime error type cannot
+    be constructed (jax not importable / exotic jaxlib). The message still
+    carries RESOURCE_EXHAUSTED so :func:`is_resource_exhausted` matches."""
+
+
+def _xla_runtime_error_type():
+    try:
+        from jaxlib.xla_extension import XlaRuntimeError
+        return XlaRuntimeError
+    except Exception:
+        return None
+
+
+def _oom_error(point: str, hit: int) -> BaseException:
+    """Simulated device OOM: the REAL XLA error type with the REAL status
+    prefix, so product recovery paths (which catch XlaRuntimeError and match
+    RESOURCE_EXHAUSTED) exercise the exact branch a production OOM takes."""
+    msg = (f"RESOURCE_EXHAUSTED: injected device OOM at '{point}' "
+           f"(hit #{hit})")
+    err_t = _xla_runtime_error_type()
+    if err_t is not None:
+        try:
+            return err_t(msg)
+        except Exception:
+            pass
+    return SimulatedOomError(msg)
+
+
+def is_resource_exhausted(exc: BaseException) -> bool:
+    """True for XLA allocation failures: the runtime surfaces device OOM as
+    an ``XlaRuntimeError`` whose message starts with the canonical absl
+    status name ``RESOURCE_EXHAUSTED`` (same for the injected form)."""
+    if isinstance(exc, SimulatedOomError):
+        return True
+    err_t = _xla_runtime_error_type()
+    if err_t is not None and not isinstance(exc, err_t):
+        return False
+    return "RESOURCE_EXHAUSTED" in str(exc)
+
+
+def is_device_fault(exc: BaseException) -> bool:
+    """Classify an exception as a device-level fault: a real (or injected)
+    XLA RESOURCE_EXHAUSTED, or a :class:`FaultInjected` from one of the
+    device chaos points. This is the predicate the ``on_device_fault``
+    recovery policies key on (ingest.py, models/gbdt.py)."""
+    if isinstance(exc, FaultInjected):
+        return exc.point in DEVICE_FAULT_POINTS
+    return is_resource_exhausted(exc)
+
+
+def classify_point(exc: BaseException, default: str = "device") -> str:
+    """Best-effort fault-point name for telemetry: the point attribute for
+    :class:`FaultInjected`, a registry name embedded in the message for the
+    simulated-OOM injectors, else ``default`` (real faults carry no point)."""
+    if isinstance(exc, FaultInjected):
+        return exc.point
+    msg = str(exc)
+    for p in DEVICE_FAULT_POINTS:
+        if p in msg:
+            return p
+    return default
 
 
 def _parse_spec(spec: str) -> Dict[str, list]:
@@ -67,21 +176,28 @@ def _parse_spec(spec: str) -> Dict[str, list]:
         name = name.strip()
         n = -1 if count.strip() in ("-1", "*", "inf") else int(count)
         if name not in KNOWN_POINTS:
-            log.warning(f"unknown fault point '{name}' "
-                        f"(known: {', '.join(KNOWN_POINTS)}); arming anyway")
+            # reject, don't warn-and-arm: a typo'd point would never fire,
+            # so the chaos test it belongs to would pass without injecting
+            # anything — a fault harness that can silently do nothing is
+            # worse than none
+            raise ValueError(
+                f"unknown fault point '{name}' in spec {spec!r}; known "
+                f"points: {', '.join(KNOWN_POINTS)} (see the registry in "
+                "lightgbm_tpu/utils/faults.py)")
         out[name] = [skip, n]
     return out
 
 
 def configure(spec: Optional[str]) -> None:
-    """Arm fault points from a spec string (empty/None disarms everything)."""
+    """Arm fault points from a spec string (empty/None disarms everything).
+    Raises ValueError on an unknown point name."""
     global _env_loaded
+    armed = _parse_spec(spec) if spec else {}
     with _lock:
         _armed.clear()
         _hits.clear()
         _env_loaded = True   # explicit configure overrides the env var
-        if spec:
-            _armed.update(_parse_spec(spec))
+        _armed.update(armed)
 
 
 def reset() -> None:
@@ -105,8 +221,9 @@ def _ensure_env_loaded() -> None:
 
 
 def fault_point(name: str) -> None:
-    """Hot-path hook: no-op unless ``name`` is armed, else raise
-    :class:`FaultInjected` while the armed count lasts."""
+    """Hot-path hook: no-op unless ``name`` is armed, else raise — a
+    :class:`FaultInjected`, or for the simulated-OOM points the real XLA
+    ``RESOURCE_EXHAUSTED`` error type — while the armed count lasts."""
     with _lock:
         _ensure_env_loaded()
         state = _armed.get(name)
@@ -123,6 +240,8 @@ def fault_point(name: str) -> None:
         hit = _hits[name]
     from .. import obs   # lazy: obs -> atomic_io -> this module
     obs.emit("fault_injected", point=name, hit=hit)
+    if name in _OOM_POINTS:
+        raise _oom_error(name, hit)
     raise FaultInjected(name, hit)
 
 

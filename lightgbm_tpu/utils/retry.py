@@ -31,10 +31,18 @@ def backoff_delays(attempts: int, base_delay: float = 0.1,
 def call_with_backoff(fn: Callable, *, attempts: int = 3,
                       base_delay: float = 0.1, max_delay: float = 30.0,
                       retry_on: Tuple[Type[BaseException], ...] = (Exception,),
+                      should_retry: Optional[
+                          Callable[[BaseException], bool]] = None,
                       name: Optional[str] = None,
                       sleep: Callable[[float], None] = time.sleep):
     """Call ``fn()``; on a ``retry_on`` exception retry with exponential
-    backoff, re-raising the last error once ``attempts`` are exhausted."""
+    backoff, re-raising the last error once ``attempts`` are exhausted.
+
+    ``should_retry`` further narrows ``retry_on`` by value rather than type —
+    needed where the retryable and fatal cases share an exception class
+    (e.g. ``XlaRuntimeError``: RESOURCE_EXHAUSTED is retryable after chunk
+    halving, a compile error is not; see ``utils.faults.is_device_fault``).
+    """
     what = name or getattr(fn, "__name__", "operation")
     delays = list(backoff_delays(attempts, base_delay, max_delay))
     last: Optional[BaseException] = None
@@ -42,6 +50,8 @@ def call_with_backoff(fn: Callable, *, attempts: int = 3,
         try:
             return fn()
         except retry_on as e:   # noqa: PERF203 - retry loop by design
+            if should_retry is not None and not should_retry(e):
+                raise
             last = e
             if i >= len(delays):
                 break

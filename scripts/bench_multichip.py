@@ -22,7 +22,13 @@ same technique tests/test_mesh_training.py uses to turn "equal up to ulps"
 into "bit-identical". With the builtin sigmoid objective the runs must
 still agree to f32 noise; that max|Δpred| is recorded alongside.
 
-Usage: python scripts/bench_multichip.py [out.json]
+``--chaos`` runs the fault-recovery bench instead: one ``shard_commit``
+fault is injected into an otherwise-identical sharded lattice run and the
+JSON records ``recovery_overhead_s`` (chaos wall minus clean wall) plus a
+post-recovery tree-hash equality check — the bit-identity invariant must
+survive the recovery ladder, not just the happy path.
+
+Usage: python scripts/bench_multichip.py [--chaos] [out.json]
 (must run in a fresh process: it forces the CPU backend and the virtual
 device count BEFORE jax initializes).
 """
@@ -163,5 +169,84 @@ def run(out_path=None, shard_counts=None):
     return result
 
 
+def run_chaos(out_path=None, num_shards=2):
+    """Fault-recovery bench: identical lattice runs with and without one
+    injected ``shard_commit`` fault (``on_device_fault=reshard`` policy).
+    The delta is the recovery overhead; the hash check asserts the recovered
+    run's trees are still bit-identical to the clean run's."""
+    # x2 headroom so the reshard rung of the recovery ladder has devices to
+    # grow into if chunk halving alone doesn't clear the fault
+    _force_virtual_devices(num_shards * 2)
+    sys.path.insert(0, os.path.dirname(os.path.dirname(
+        os.path.abspath(__file__))))
+    import jax
+    jax.config.update("jax_platforms", "cpu")
+
+    import lightgbm_tpu as lgb
+    from lightgbm_tpu.utils import faults
+
+    from bench import synth_higgs
+    rows = min(N_ROWS, 50_000)
+    X, y = synth_higgs(rows)
+    hp = {"objective": "none", "num_leaves": 31, "max_bin": 63,
+          "learning_rate": 0.1, "min_data_in_leaf": 20, "verbose": -1,
+          "seed": 3, "num_shards": num_shards, "prewarm": 0}
+
+    def _tree_section_hash(booster) -> str:
+        # the runs legitimately differ in params (faults/on_device_fault),
+        # so hash ONLY the tree section, like tests/test_zz_mesh_faults.py
+        import hashlib
+        body = booster.model_to_string().split("\nparameters:\n")[0]
+        return hashlib.sha256(body.encode()).hexdigest()
+
+    # untimed warmup so both timed runs see a warm compile cache — without
+    # it the clean run eats the XLA compile and the overhead goes negative
+    lgb.train(hp, lgb.Dataset(X, label=y, params=hp),
+              num_boost_round=3, fobj=_lattice_fobj)
+
+    t0 = time.perf_counter()
+    clean = lgb.train(hp, lgb.Dataset(X, label=y, params=hp),
+                      num_boost_round=3, fobj=_lattice_fobj)
+    clean_s = time.perf_counter() - t0
+
+    # the Dataset must NOT be constructed before lgb.train: the engine arms
+    # the fault spec first, so the injection fires inside the sharded ingest
+    chp = dict(hp, faults="shard_commit:1", on_device_fault="reshard")
+    t0 = time.perf_counter()
+    try:
+        chaos = lgb.train(chp, lgb.Dataset(X, label=y, params=chp),
+                          num_boost_round=3, fobj=_lattice_fobj)
+    finally:
+        faults.reset()
+    chaos_s = time.perf_counter() - t0
+
+    h_clean, h_chaos = _tree_section_hash(clean), _tree_section_hash(chaos)
+    result = {
+        "bench": "multichip_chaos",
+        "mode": "fault_recovery_run",
+        "rows": rows,
+        "num_shards": num_shards,
+        "devices": len(jax.devices()),
+        "fault": "shard_commit:1",
+        "policy": "reshard",
+        "clean_s": round(clean_s, 3),
+        "chaos_s": round(chaos_s, 3),
+        "recovery_overhead_s": round(chaos_s - clean_s, 3),
+        "tree_hash_clean": h_clean[:16],
+        "tree_hash_after_recovery": h_chaos[:16],
+        "tree_hash_equal_after_recovery": h_clean == h_chaos,
+    }
+    doc = json.dumps(result, indent=2)
+    if out_path:
+        from lightgbm_tpu.utils.atomic_io import atomic_write_text
+        atomic_write_text(out_path, doc + "\n")
+    print(doc)
+    return result
+
+
 if __name__ == "__main__":
-    run(sys.argv[1] if len(sys.argv) > 1 else None)
+    argv = [a for a in sys.argv[1:] if a != "--chaos"]
+    if len(argv) < len(sys.argv) - 1:
+        run_chaos(argv[0] if argv else None)
+    else:
+        run(argv[0] if argv else None)

@@ -70,6 +70,31 @@ def main():
     good_conf = Config({"learning_rate": 0.2})
     assert consistency_fence(good_conf, _Shim(rank_offset=0.0)) is True
 
+    # ---- mesh topology divergence: ranks disagreeing on the shard grid
+    # dispatch incompatible collectives (a hang, not an error) — both the
+    # num_shards config field and the published shard plan are fenced ----
+    from types import SimpleNamespace
+    captured.clear()
+    mesh_conf = Config({"learning_rate": 0.2, "num_shards": 2 + rank})
+    shim = _Shim(rank_offset=0.0)
+    shim.shard_plan = SimpleNamespace(
+        axis_name="data", num_shards=2 + rank, n_rows=100,
+        rows_per_shard=-(-100 // (2 + rank)))
+    ok = consistency_fence(mesh_conf, shim, raise_on_mismatch=False)
+    assert ok is False, "fence passed on divergent shard grid"
+    blob = "".join(captured)
+    assert "config.num_shards" in blob, blob
+    assert "data.shard_plan" in blob, blob
+    assert "config.learning_rate" not in blob, \
+        f"fence flagged a field that matches: {blob}"
+
+    # matching grid passes
+    same_conf = Config({"learning_rate": 0.2, "num_shards": 2})
+    shim = _Shim(rank_offset=0.0)
+    shim.shard_plan = SimpleNamespace(axis_name="data", num_shards=2,
+                                      n_rows=100, rows_per_shard=50)
+    assert consistency_fence(same_conf, shim) is True
+
     log.set_callback(None)
     print(f"FENCE_WORKER_OK rank={rank}")
 

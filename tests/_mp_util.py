@@ -1,10 +1,13 @@
-"""Shared helpers for the 2-process jax.distributed tests.
+"""Shared helpers for the multi-process jax.distributed tests.
 
 ``_free_port()`` has an inherent bind/release race: the port can be stolen
 between ``close()`` and the coordinator's bind. Instead of pretending the
-race away, ``spawn_two_ranks`` retries the WHOLE 2-process spawn on a fresh
+race away, ``spawn_ranks`` retries the WHOLE n-process spawn on a fresh
 port when the workers die with an address-in-use error, reusing the
-package's backoff helper (lightgbm_tpu/utils/retry.py).
+package's backoff helper (lightgbm_tpu/utils/retry.py). Every multiprocess
+test — distributed data, the consistency fence, and the mesh-fence tests —
+goes through this one spawn path so the race fix covers all of them;
+``spawn_two_ranks``/``run_two_ranks`` remain as 2-rank wrappers.
 """
 import os
 import socket
@@ -28,14 +31,14 @@ def _looks_like_port_clash(outs) -> bool:
     return any(m in out.lower() for out in outs for m in _ADDR_IN_USE_MARKERS)
 
 
-def run_two_ranks(worker_args, timeout=480, cwd="/root/repo"):
-    """Spawn rank 0/1 subprocesses running ``worker_args(port)``; returns
-    (procs, outs) after both exit."""
+def run_n_ranks(worker_args, nprocs=2, timeout=480, cwd="/root/repo"):
+    """Spawn rank 0..nprocs-1 subprocesses running ``worker_args(port)``;
+    returns (procs, outs) after all exit."""
     port = free_port()
     env_base = {k: v for k, v in os.environ.items() if k not in ("XLA_FLAGS",)}
     env_base["JAX_PLATFORMS"] = "cpu"
     procs = []
-    for rank in range(2):
+    for rank in range(nprocs):
         env = dict(env_base)
         env["JAX_PROCESS_ID"] = str(rank)
         procs.append(subprocess.Popen(
@@ -53,17 +56,28 @@ def run_two_ranks(worker_args, timeout=480, cwd="/root/repo"):
     return procs, outs
 
 
-def spawn_two_ranks(worker_args, timeout=480, attempts=3, cwd="/root/repo"):
-    """run_two_ranks with address-in-use retry on a fresh port each attempt."""
+def spawn_ranks(worker_args, nprocs=2, timeout=480, attempts=3,
+                cwd="/root/repo"):
+    """run_n_ranks with address-in-use retry on a fresh port each attempt."""
     import sys as _sys
     _sys.path.insert(0, cwd)
     from lightgbm_tpu.utils.retry import backoff_delays
     delays = list(backoff_delays(attempts, base_delay=0.5)) + [0.0]
     for attempt in range(attempts):
-        procs, outs = run_two_ranks(worker_args, timeout=timeout, cwd=cwd)
+        procs, outs = run_n_ranks(worker_args, nprocs=nprocs,
+                                  timeout=timeout, cwd=cwd)
         failed = any(p.returncode != 0 for p in procs)
         if failed and _looks_like_port_clash(outs) and attempt < attempts - 1:
             time.sleep(delays[attempt])
             continue
         return procs, outs
     return procs, outs
+
+
+def run_two_ranks(worker_args, timeout=480, cwd="/root/repo"):
+    return run_n_ranks(worker_args, nprocs=2, timeout=timeout, cwd=cwd)
+
+
+def spawn_two_ranks(worker_args, timeout=480, attempts=3, cwd="/root/repo"):
+    return spawn_ranks(worker_args, nprocs=2, timeout=timeout,
+                       attempts=attempts, cwd=cwd)

@@ -27,3 +27,35 @@ jax.config.update("jax_persistent_cache_enable_xla_caches", "all")
 @pytest.fixture
 def rng():
     return np.random.RandomState(42)
+
+
+# default wall budget for a @pytest.mark.chaos test: recovery paths that work
+# finish in a few seconds on the CPU mesh, and a HUNG one (deadlocked queue,
+# retry loop that never terminates) must fail here, not at the 870s tier-1
+# wall where it would take the whole suite down with it
+CHAOS_TIMEOUT_S = 120
+
+
+@pytest.fixture(autouse=True)
+def _chaos_timeout(request):
+    """SIGALRM watchdog for chaos-marked tests (pytest runs tests on the main
+    thread, so the alarm interrupts even a blocking queue.get)."""
+    import signal
+    m = request.node.get_closest_marker("chaos")
+    if m is None or not hasattr(signal, "SIGALRM"):
+        yield
+        return
+    budget = int(m.kwargs.get("timeout", CHAOS_TIMEOUT_S))
+
+    def _timed_out(signum, frame):
+        raise TimeoutError(
+            f"chaos test exceeded its {budget}s timeout guard — a recovery "
+            "path is hung (see pytest.ini 'chaos' marker)")
+
+    old = signal.signal(signal.SIGALRM, _timed_out)
+    signal.alarm(budget)
+    try:
+        yield
+    finally:
+        signal.alarm(0)
+        signal.signal(signal.SIGALRM, old)

@@ -479,6 +479,96 @@ def test_unsharded_transfer_clean_with_placement():
         analyze_source(UNSHARDED_CLEAN, relpath=MESH_REL))
 
 
+# ---- swallowed-device-error ----
+
+SWALLOWED_BAD = """
+import jax
+
+def upload(chunk, dev):
+    try:
+        x = jax.device_put(chunk, dev)
+        x.block_until_ready()
+    except Exception as e:
+        log.debug("upload failed: %s", e)
+"""
+
+SWALLOWED_SUPPRESSED = """
+import jax
+
+def probe(x):
+    try:
+        jax.device_put(x).block_until_ready()
+    except Exception as e:   # tpu-lint: disable=swallowed-device-error
+        log.debug("probe failed: %s", e)
+"""
+
+SWALLOWED_CLEAN = """
+import jax
+from .utils.retry import call_with_backoff
+
+def upload(chunk, dev, _fail):
+    try:
+        return jax.device_put(chunk, dev)
+    except Exception as e:
+        _fail(e)                      # stash-and-surface handoff
+
+def upload_retry(chunk, dev):
+    return call_with_backoff(lambda: jax.device_put(chunk, dev))
+
+def upload_emit(chunk, dev):
+    try:
+        return jax.device_put(chunk, dev)
+    except Exception as e:
+        emit("device_fault", point="h2d", policy="fatal", action="fatal")
+        raise
+
+def narrow(chunk, dev):
+    try:
+        return jax.device_put(chunk, dev)
+    except TypeError:
+        return None
+"""
+
+PRODUCT_REL = "lightgbm_tpu/serving.py"
+
+
+def test_swallowed_device_error_fires():
+    fs = analyze_source(SWALLOWED_BAD, relpath=PRODUCT_REL)
+    assert any(f.rule == "swallowed-device-error" for f in fs)
+    # bare except and tuple forms count as broad too
+    bare = SWALLOWED_BAD.replace("except Exception as e:", "except:")
+    bare = bare.replace('log.debug("upload failed: %s", e)', "pass")
+    assert "swallowed-device-error" in names(
+        analyze_source(bare, relpath=PRODUCT_REL))
+    tup = SWALLOWED_BAD.replace("except Exception as e:",
+                                "except (ValueError, XlaRuntimeError) as e:")
+    assert "swallowed-device-error" in names(
+        analyze_source(tup, relpath=PRODUCT_REL))
+
+
+def test_swallowed_device_error_out_of_scope_silent():
+    # tests/scripts may swallow freely; so does the analyzer itself
+    assert "swallowed-device-error" not in names(
+        analyze_source(SWALLOWED_BAD, relpath="tests/test_something.py"))
+    assert "swallowed-device-error" not in names(
+        analyze_source(SWALLOWED_BAD,
+                       relpath="lightgbm_tpu/analysis/core.py"))
+
+
+def test_swallowed_device_error_suppressed():
+    assert "swallowed-device-error" not in names(
+        analyze_source(SWALLOWED_SUPPRESSED, relpath=PRODUCT_REL))
+    kept = analyze_source(SWALLOWED_SUPPRESSED, relpath=PRODUCT_REL,
+                          keep_suppressed=True)
+    assert "swallowed-device-error" in names(kept)
+
+
+def test_swallowed_device_error_clean_escape_hatches():
+    # handoff / retry / emit+reraise / narrow except are all acceptable
+    assert "swallowed-device-error" not in names(
+        analyze_source(SWALLOWED_CLEAN, relpath=PRODUCT_REL))
+
+
 # ---------------------------------------------------------------------------
 # suppression / baseline machinery
 

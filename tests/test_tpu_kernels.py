@@ -15,12 +15,31 @@ import pytest
 _CHECK = os.path.join(os.path.dirname(__file__), "_tpu_kernel_check.py")
 
 
+def _probe_cache_path():
+    """Negative probes are cached per boot: TPU absence does not change
+    under a running kernel, and re-discovering it costs the full probe
+    timeout on every tier-1 run of a CPU-only box. A positive probe is
+    never cached (a healthy TPU initializes in seconds anyway, and a
+    tunneled TPU can detach between runs)."""
+    try:
+        with open("/proc/sys/kernel/random/boot_id") as f:
+            boot = f.read().strip()
+    except OSError:
+        return None
+    import tempfile
+    return os.path.join(tempfile.gettempdir(),
+                        f"lgbm_tpu_probe_no_tpu.{boot}")
+
+
 def _probe_tpu_backend(env, timeout=120):
     """Bounded backend probe. A TPU plugin that is installed but cannot reach
     hardware retries its connection for many MINUTES before falling back to
     CPU (measured ~460 s on a CPU-only box) — most of the tier-1 time budget
     spent deciding to skip. A healthy attached/tunneled TPU initializes in
     seconds, so cap the probe and treat a timeout as "no TPU"."""
+    cache = _probe_cache_path()
+    if cache is not None and os.path.exists(cache):
+        return False
     try:
         proc = subprocess.run(
             [sys.executable, "-c",
@@ -28,9 +47,16 @@ def _probe_tpu_backend(env, timeout=120):
              " else 3)"],
             env=env, stdout=subprocess.PIPE, stderr=subprocess.STDOUT,
             timeout=timeout)
+        ok = proc.returncode == 0
     except subprocess.TimeoutExpired:
-        return False
-    return proc.returncode == 0
+        ok = False
+    if not ok and cache is not None:
+        try:
+            with open(cache, "w") as f:
+                f.write("negative TPU probe cached for this boot\n")
+        except OSError:
+            pass
+    return ok
 
 
 def test_compiled_pallas_kernels_on_tpu():
