@@ -110,10 +110,20 @@ def main():
                         "LGBM_TPU_PREDICT_BENCH_REPEATS", 3)))
     ap.add_argument("--ref-cli",
                     default=os.path.join(REPO, ".refbuild", "lightgbm"))
+    ap.add_argument("--quick", action="store_true",
+                    help="tier-1-budget mode: caps batches at 100k rows, "
+                         "the model at 20k x 10 x 63 leaves, one repeat — "
+                         "a smoke-scale run, not a recordable headline")
     ap.add_argument("--out", default=None,
                     help="write the full JSON document here "
                          "(e.g. PREDICT_BENCH.json)")
     args = ap.parse_args()
+    if args.quick:
+        args.rows = min(args.rows, 100_000)
+        args.train_rows = min(args.train_rows, 20_000)
+        args.iters = min(args.iters, 10)
+        args.leaves = min(args.leaves, 63)
+        args.repeats = min(args.repeats, 1)
 
     import jax
     import lightgbm_tpu as lgb  # noqa: F401  (registers compile cache)
@@ -178,10 +188,10 @@ def main():
             if "vs_ref_cli" in prior:
                 doc["vs_ref_cli"] = prior["vs_ref_cli"]
         else:
-            doc["ref_cli_predict"] = {
-                "status": "cli_not_available",
-                "invocation": f"python bench_predict.py --ref-cli "
-                              f"{args.ref_cli}"}
+            # clean skip: no invocation string — a recorded command line
+            # reads as "this was run", which it was not; the status alone
+            # says how to fill it (run on a host that has the CLI binary)
+            doc["ref_cli_predict"] = {"status": "cli_not_available"}
 
     big = entries[0]
     print(json.dumps({

@@ -18,6 +18,13 @@ those loops stall the async dispatch pipeline the lagged telemetry design
 exists to protect — except where a sync IS the design (measured transfer
 completion, donation backpressure), which must say so in an inline
 suppression.
+
+The serving scheduler loop (server.py ``_scheduler_loop``) gets a stricter
+audit: one thread drains the shared request queue, so ANY blocking call
+there — ``time.sleep``, an unbounded ``.join()``, a ``.get()`` with no
+timeout — stalls every queued request, not just its own (the
+blocking-call-in-scheduler-loop hazard). All waiting must happen on the
+queue itself, with a timeout.
 """
 from __future__ import annotations
 
@@ -41,6 +48,17 @@ HOT_LOOPS: Set[Tuple[str, str]] = {
     ("lightgbm_tpu/engine.py", "train"),
     ("lightgbm_tpu/ingest.py", "_h2d_loop"),
     ("lightgbm_tpu/ingest.py", "_commit_loop"),
+    ("lightgbm_tpu/server.py", "_scheduler_loop"),
+}
+
+# scheduler loops (server.py MicroBatcher): ONE thread drains the shared
+# request queue, so any blocking call there stalls EVERY queued request, not
+# just the current one — time.sleep (polling where the queue itself should
+# wait), an unbounded thread .join(), or a q.get() with no timeout (deaf to
+# shutdown). The clean idiom is q.get(timeout=...) / get_nowait(): all
+# waiting happens on the queue, bounded, interruptible.
+SCHED_LOOPS: Set[Tuple[str, str]] = {
+    ("lightgbm_tpu/server.py", "_scheduler_loop"),
 }
 
 
@@ -58,9 +76,11 @@ class HostSyncInJit(Rule):
         for fn, static_names in jitted:
             self._check_jit_body(ctx, fn, static_names)
         for node in ast.walk(ctx.tree):
-            if isinstance(node, (ast.FunctionDef, ast.AsyncFunctionDef)) and \
-                    (ctx.relpath, node.name) in HOT_LOOPS:
-                self._check_hot_loop(ctx, node)
+            if isinstance(node, (ast.FunctionDef, ast.AsyncFunctionDef)):
+                if (ctx.relpath, node.name) in HOT_LOOPS:
+                    self._check_hot_loop(ctx, node)
+                if (ctx.relpath, node.name) in SCHED_LOOPS:
+                    self._check_sched_loop(ctx, node)
 
     # -- jitted function bodies --
     def _check_jit_body(self, ctx: ModuleContext, fn: ast.AST,
@@ -121,6 +141,41 @@ class HostSyncInJit(Rule):
                                "loop blocks the async dispatch pipeline "
                                "every iteration; read lagged copies outside "
                                "the loop (see obs_lagged_stats)")
+
+
+    # -- request-scheduler loops: blocking-call-in-scheduler-loop hazard --
+    def _check_sched_loop(self, ctx: ModuleContext, fn: ast.AST) -> None:
+        """A scheduler loop may only ever wait ON ITS QUEUE, with a timeout:
+        flag time.sleep (the queue should do the waiting), ``.join()`` with
+        no timeout (unbounded stall of every queued request), and ``.get()``
+        with neither timeout nor args (blocks forever, deaf to shutdown)."""
+        for loop in ast.walk(fn):
+            if not isinstance(loop, (ast.For, ast.While)):
+                continue
+            for node in ast.walk(loop):
+                if not isinstance(node, ast.Call):
+                    continue
+                f = node.func
+                fname = f.attr if isinstance(f, ast.Attribute) else \
+                    f.id if isinstance(f, ast.Name) else ""
+                if fname == "sleep":
+                    ctx.report(self, node,
+                               f"sleep inside the {fn.name}() scheduler loop "
+                               "stalls every queued request; wait on the "
+                               "queue instead (q.get(timeout=...))")
+                elif fname == "join" and not node.args and not node.keywords:
+                    ctx.report(self, node,
+                               f".join() with no timeout inside the "
+                               f"{fn.name}() scheduler loop can block "
+                               "forever; pass a timeout or hand the wait to "
+                               "the queue")
+                elif fname == "get" and not node.args and \
+                        not any(kw.arg == "timeout" for kw in node.keywords):
+                    ctx.report(self, node,
+                               f".get() with no timeout inside the "
+                               f"{fn.name}() scheduler loop blocks forever "
+                               "and is deaf to shutdown; use "
+                               "get(timeout=...) or get_nowait()")
 
 
 def _is_static_metadata(node: ast.AST) -> bool:

@@ -9,8 +9,8 @@ reads it. CPython's GIL makes single bytecodes atomic but NOT compound
 check-then-act sequences; the classic symptom is a shape-bucket cache that
 intermittently serves a half-built entry.
 
-Scope is intentionally narrow (``serving.py``, ``ingest.py``, ``obs/``):
-elsewhere,
+Scope is intentionally narrow (``serving.py``, ``server.py``, ``ingest.py``,
+``obs/``): elsewhere,
 module-level mutation is the normal single-threaded idiom and flagging it
 would be noise. Within scope, the rule flags
 
@@ -33,8 +33,10 @@ from typing import Set
 from ..core import ModuleContext, Rule, register, root_name
 
 # exact file paths / directory prefixes that are deliberately multi-threaded:
-# the serving engine, the obs sinks, and the chunked ingest pipeline
-_SCOPE_FILES = ("lightgbm_tpu/serving.py", "lightgbm_tpu/ingest.py")
+# the serving engine + microbatch scheduler, the obs sinks, and the chunked
+# ingest pipeline
+_SCOPE_FILES = ("lightgbm_tpu/serving.py", "lightgbm_tpu/server.py",
+                "lightgbm_tpu/ingest.py")
 _SCOPE_DIRS = ("lightgbm_tpu/obs/",)
 _MUTATING_METHODS = {"append", "extend", "add", "update", "setdefault",
                      "pop", "popitem", "clear", "remove", "insert",

@@ -224,6 +224,38 @@ def run_refit(conf: Config, params: Dict) -> None:
     log.info(f"Finished refit; model saved to {conf.output_model}")
 
 
+def run_serve(conf: Config, params: Dict) -> None:
+    """task=serve: publish input_model into a hot-swappable registry behind
+    the request-coalescing microbatcher (server.py) and serve the newline
+    protocol — over TCP when serve_port>0, else over stdin/stdout.
+
+    Protocol (one line per request):
+      ``v1,v2,...``       feature row -> ``<version>\\t<score>``
+      ``!publish <path>`` atomic hot-swap to a new model version
+      ``!stats``          one-line JSON (scheduler + per-model stats)
+      ``!quit``           shut down
+    """
+    if not conf.input_model:
+        log.fatal("No model file: set input_model=<file>")
+    from .server import PredictServer, serve_stdio, serve_tcp
+    server = PredictServer(conf, model=conf.input_model)
+    log.info(f"Published {conf.input_model} as version 1; serving "
+             f"(window={conf.serve_batch_window_us}us, "
+             f"queue_max={conf.serve_queue_max}, "
+             f"max_batch_rows={conf.serve_max_batch_rows})")
+    try:
+        if conf.serve_port > 0:
+            serve_tcp(server, "0.0.0.0", conf.serve_port)
+        else:
+            served = serve_stdio(server, sys.stdin, sys.stdout)
+            log.info(f"Finished serving; {served} lines handled")
+    finally:
+        server.close()
+        exported = obs.export_all(conf.metrics_out)
+        if exported:
+            log.info("telemetry exported to %s", exported)
+
+
 def run_convert_model(conf: Config, params: Dict) -> None:
     if not conf.input_model:
         log.fatal("No model file: set input_model=<file>")
@@ -258,6 +290,8 @@ def main(argv: List[str]) -> int:
         run_predict(conf, params)
     elif task == "convert_model":
         run_convert_model(conf, params)
+    elif task == "serve":
+        run_serve(conf, params)
     else:
         log.fatal(f"Unknown task: {task}")
     return 0

@@ -175,3 +175,58 @@ def booster_finish_training(booster) -> int:
     if booster._gbdt is not None:
         booster._gbdt.finish_training()
     return 0
+
+
+# ---- online serving (server.py; reference analog:
+# LGBM_BoosterPredictForMatSingleRowFast, c_api.h:919 — a pre-configured
+# fast path for interactive traffic; ours additionally coalesces concurrent
+# callers into shared device dispatches and hot-swaps model versions) ----
+
+def server_create(model_path: str, params_str: str):
+    """Opaque PredictServer handle: publishes ``model_path`` as version 1
+    (engine built + per-bucket warmed before the call returns, so the first
+    request never eats a compile)."""
+    from .server import PredictServer
+    return PredictServer(_parse_params(params_str), model=model_path)
+
+
+def server_predict(server, data_addr: int, nrow: int, ncol: int,
+                   raw_score: int, pred_leaf: int, out_addr: int,
+                   out_cap: int) -> int:
+    """Coalesced predict: blocks until the scheduler's flush serves this
+    request (concurrent C threads share device dispatches). Returns doubles
+    written, -1 if out_cap is too small, -2 if shed at overload."""
+    from .server import ServeOverload
+    src = (ctypes.c_double * (nrow * ncol)).from_address(data_addr)
+    x = np.frombuffer(src, dtype=np.float64).reshape(nrow, ncol)
+    try:
+        out = server.predict(x, raw_score=bool(raw_score),
+                             pred_leaf=bool(pred_leaf))
+    except ServeOverload:
+        return -2
+    out = np.ascontiguousarray(np.asarray(out, dtype=np.float64)).reshape(-1)
+    if out.size > out_cap:
+        return -1
+    ctypes.memmove(out_addr, out.ctypes.data, out.nbytes)
+    return int(out.size)
+
+
+def server_publish(server, model_path: str) -> int:
+    """Atomic hot-swap to a new model version; returns the new version
+    number. In-flight requests finish on the version that was current when
+    their flush started; the old version's device tables are freed once it
+    drains."""
+    return int(server.publish(model_path))
+
+
+def server_stats_json(server) -> str:
+    """One-line JSON: scheduler counters (requests/flushes/shed/coalesce
+    factor/queue depth) + per-model registry state."""
+    import json
+    return json.dumps(server.stats(), sort_keys=True)
+
+
+def server_close(server) -> int:
+    """Drain queued requests, stop the scheduler thread."""
+    server.close()
+    return 0

@@ -208,6 +208,21 @@ _PARAMS: Dict[str, Tuple[Any, Tuple[str, ...]]] = {
     # when available | fallback_single = degrade to the single-device path
     # with a warning. Every recovery emits a `device_fault` telemetry event.
     "on_device_fault": ("reshard", ("device_fault_policy",)),
+    # ---- online serving (task=serve; see lightgbm_tpu/server.py) ----
+    # request-coalescing window: a flush waits at most this long after the
+    # first staged request for more requests to share its device dispatch
+    # (0 = flush immediately, i.e. disable coalescing). ~200us trades <1ms
+    # added p50 for order-of-magnitude dispatch amortization under load.
+    "serve_batch_window_us": (200, ("batch_window_us",)),
+    # bounded staging queue: at overload submit() sheds (ServeOverload)
+    # instead of queueing unboundedly, so tail latency stays bounded
+    "serve_queue_max": (8192, ()),
+    # rows per coalesced flush; also the largest single request the serve
+    # path accepts (bigger batches belong on Booster.predict)
+    "serve_max_batch_rows": (1024, ()),
+    # task=serve transport: 0 = stdio line protocol, >0 = threaded TCP
+    # server on this port
+    "serve_port": (0, ()),
     # ---- observability (new in this framework; see lightgbm_tpu/obs/) ----
     # structured telemetry: schema'd events + metrics around the hot paths;
     # LGBMTPU_TELEMETRY=0/1 env overrides the param in either direction
@@ -347,6 +362,14 @@ class Config:
         if self.on_device_fault not in ("fatal", "reshard", "fallback_single"):
             log.fatal("on_device_fault must be one of fatal|reshard|"
                       f"fallback_single, got {self.on_device_fault!r}")
+        if self.serve_batch_window_us < 0:
+            log.fatal("serve_batch_window_us must be >= 0 (0 = no coalescing)")
+        if self.serve_queue_max < 1:
+            log.fatal("serve_queue_max must be >= 1")
+        if self.serve_max_batch_rows < 1:
+            log.fatal("serve_max_batch_rows must be >= 1")
+        if not 0 <= self.serve_port <= 65535:
+            log.fatal(f"serve_port must be in [0, 65535], got {self.serve_port}")
 
     def to_dict(self) -> Dict[str, Any]:
         out = {name: getattr(self, name) for name in _PARAMS}

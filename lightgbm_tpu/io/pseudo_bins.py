@@ -111,10 +111,18 @@ class PseudoRouter:
             self._dense = ensemble_path_tables(self.stack, self.na_id)
         return self._dense
 
-    def bin_matrix(self, x: np.ndarray) -> np.ndarray:
-        """[N, F] f64 raw features -> [N, F] i32 pseudo-bins (host, exact)."""
+    def bin_matrix(self, x: np.ndarray,
+                   out: "np.ndarray | None" = None) -> np.ndarray:
+        """[N, F] f64 raw features -> [N, F] i32 pseudo-bins (host, exact).
+
+        ``out`` reuses a caller-owned [N, F] i32 buffer (serve staging path);
+        every column is fully overwritten, so a dirty buffer is fine."""
         n, f = x.shape
-        out = np.zeros((n, f), dtype=np.int32)
+        if out is None:
+            out = np.zeros((n, f), dtype=np.int32)
+        elif out.shape != (n, f) or out.dtype != np.int32:
+            raise ValueError(f"out must be [{n}, {f}] int32, got "
+                             f"{out.shape} {out.dtype}")
         for j in range(f):
             v = np.asarray(x[:, j], dtype=np.float64)
             if self.is_cat_feat[j]:

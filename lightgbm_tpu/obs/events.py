@@ -55,6 +55,22 @@ EVENT_SCHEMAS: Dict[str, Tuple[Dict[str, Any], Dict[str, Any]]] = {
     # version change invalidated the cached one)
     "engine_upload": ({"n_trees": int, "num_class": int},
                       {"reason": str, "duration_s": _NUM}),
+    # one coalesced flush on the serve path (server.py MicroBatcher):
+    # `requests` concurrent requests shared one `bucket`-sized dispatch;
+    # wait_us is the oldest request's staging wait
+    "serve_flush": ({"rows": int, "requests": int, "bucket": int},
+                    {"model": str, "version": int, "wait_us": _NUM,
+                     "duration_s": _NUM}),
+    # a model version was published into the serving registry (engine built
+    # + warmed BEFORE the atomic swap, so duration_s is off-hot-path)
+    "serve_publish": ({"model": str, "version": int, "n_trees": int},
+                      {"duration_s": _NUM}),
+    # a hot-swapped-out version fully drained and its device tables were
+    # freed; drain_s is retire -> last in-flight flush released
+    "serve_retire": ({"model": str, "version": int},
+                     {"served_rows": int, "drain_s": _NUM}),
+    # bounded staging queue was full: one request shed (ServeOverload)
+    "serve_shed": ({"queued": int, "limit": int}, {"model": str}),
     # one chunk made it through the three-stage ingest pipeline
     # (ingest.py): per-stage durations + queue depth observed at commit
     "ingest_chunk": ({"chunk": int, "rows": int},

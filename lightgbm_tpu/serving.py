@@ -52,6 +52,18 @@ _DEF_CHUNK = 1 << 17
 # batches
 _MIN_BUCKET = 8
 
+# donation is only real on accelerator backends: on cpu XLA ignores
+# donate_argnums (with a warning per call), so the serve path keeps using
+# the exact same non-donating executables as the direct predict path there
+_CAN_DONATE = jax.default_backend() in ("tpu", "gpu")
+# donating twin of the dense kernel for the serve flush path: same traced
+# function (so identical bits), but the uploaded bin buffer is handed to XLA
+# for reuse — steady-state coalesced serving then allocates no device memory
+# beyond the first flush per bucket
+_DENSE_DONATING = jax.jit(P.predict_bins_ensemble_dense.__wrapped__,
+                          static_argnames=("group", "row_chunk", "exact_f32"),
+                          donate_argnums=(1,)) if _CAN_DONATE else None
+
 
 def bucket_rows(n: int, min_bucket: int = _MIN_BUCKET,
                 max_bucket: int = _DEF_CHUNK) -> int:
@@ -103,6 +115,7 @@ class PredictEngine:
         self.stats = {"calls": 0, "chunked_calls": 0, "chunks": 0,
                       "buckets_seen": set()}
         self._stats_lock = threading.Lock()
+        self.released = False
         obs.emit("engine_upload", n_trees=int(self.n_trees),
                  num_class=int(self.k), reason=upload_reason,
                  duration_s=time.perf_counter() - t0)
@@ -129,16 +142,26 @@ class PredictEngine:
 
     # ---- core ----
 
-    def _raw_padded(self, pbins) -> np.ndarray:
+    def _raw_padded(self, pbins, donate: bool = False) -> np.ndarray:
         """Raw scores for a device bin matrix; [B] (k=1) or [B, k] float64.
 
         Mirrors ops/predict.ensemble_raw_scores exactly (same device kernels,
         same float64 host accumulation, same average_output division) so the
-        result is bit-identical — minus the per-call upload and re-slice."""
+        result is bit-identical — minus the per-call upload and re-slice.
+
+        ``donate`` hands the uploaded bin buffer to XLA for reuse (serve
+        flush path). Only the k=1 dense path can donate — multiclass re-runs
+        the kernel on the same pbins per class — and only on backends where
+        donation is real (:data:`_CAN_DONATE`); the donating twin traces the
+        identical function, so the bits cannot differ."""
         if self._class_dense is not None:
-            def fn(tables):
-                return P.predict_bins_ensemble_dense(tables, pbins,
-                                                     exact_f32=True)
+            if donate and self.k == 1 and _DENSE_DONATING is not None:
+                def fn(tables):
+                    return _DENSE_DONATING(tables, pbins, exact_f32=True)
+            else:
+                def fn(tables):
+                    return P.predict_bins_ensemble_dense(tables, pbins,
+                                                         exact_f32=True)
             tabs = self._class_dense
         else:
             def fn(tables):
@@ -160,19 +183,34 @@ class PredictEngine:
         # leak into real rows) — keeps the executable per-bucket, not per-n
         return np.asarray(self.objective.convert_output(jnp.asarray(raw)))[:n]
 
-    def _run_bins(self, bins: np.ndarray, n: int, raw_score: bool,
-                  pred_leaf: bool) -> np.ndarray:
+    def run_binned(self, bins: np.ndarray, n: int, raw_score: bool = False,
+                   pred_leaf: bool = False, donate: bool = False
+                   ) -> np.ndarray:
+        """Score an already pseudo-binned matrix: first ``n`` rows of
+        ``bins`` are real, the rest (if any) is padding. Pads up to the
+        power-of-two bucket and dispatches the bucket executable; with
+        ``donate`` the uploaded device bin buffer is donated to XLA on
+        backends that support it (serve flush path — see server.py)."""
+        if self.released:
+            raise RuntimeError("PredictEngine used after release() — "
+                               "retired model version")
         b = bucket_rows(n, self.min_bucket, self.chunk_rows)
         with self._stats_lock:
             self.stats["buckets_seen"].add(b)
         if bins.shape[0] != b:
-            bins = np.pad(bins, ((0, b - bins.shape[0]), (0, 0)))
+            if bins.shape[0] > b:
+                bins = bins[:b]
+            else:
+                bins = np.pad(bins, ((0, b - bins.shape[0]), (0, 0)))
         pbins = jax.device_put(bins)
         if pred_leaf:
             out = P.leaf_bins_ensemble(self._stack_full(), pbins,
                                        self.na_dev, self.max_steps)
             return np.asarray(out)[:n]
-        return self._finish(self._raw_padded(pbins), n, raw_score)
+        return self._finish(self._raw_padded(pbins, donate=donate),
+                            n, raw_score)
+
+    _run_bins = run_binned
 
     def _predict_chunked(self, x: np.ndarray, raw_score: bool,
                          pred_leaf: bool) -> np.ndarray:
@@ -254,6 +292,25 @@ class PredictEngine:
                 fields["chunks"] = int(self.stats["chunks"] - chunks_before)
             obs.emit("predict_batch", **fields)
         return out
+
+    def release(self) -> None:
+        """Free the device-resident tree tables (retired model versions —
+        server.py calls this once a hot-swapped-out version drains). The
+        engine must not be used afterwards; ``released`` records the fact
+        for tests and the registry."""
+        for group in (self._class_dense or []):
+            for arr in group.values():
+                arr.delete()
+        for group in (self._class_walk or []):
+            for arr in group.values():
+                arr.delete()
+        if self._full_stack is not None:
+            for arr in self._full_stack.values():
+                arr.delete()
+        self._class_dense = None
+        self._class_walk = None
+        self._full_stack = None
+        self.released = True
 
     def warmup(self, sizes=(1,), n_features: Optional[int] = None,
                pred_leaf: bool = False) -> None:

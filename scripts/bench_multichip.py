@@ -28,9 +28,14 @@ JSON records ``recovery_overhead_s`` (chaos wall minus clean wall) plus a
 post-recovery tree-hash equality check — the bit-identity invariant must
 survive the recovery ladder, not just the happy path.
 
+``--render-table <result.json>`` renders the scaling table from a recorded
+result into ``docs/PERF_NOTES.md`` between the ``TABLE:MULTICHIP_R06``
+markers (idempotent: re-rendering replaces the previous table).
+
 Usage: python scripts/bench_multichip.py [--chaos] [out.json]
-(must run in a fresh process: it forces the CPU backend and the virtual
-device count BEFORE jax initializes).
+       python scripts/bench_multichip.py --render-table MULTICHIP_r06.json
+(bench runs must start in a fresh process: they force the CPU backend and
+the virtual device count BEFORE jax initializes).
 """
 import json
 import os
@@ -244,9 +249,70 @@ def run_chaos(out_path=None, num_shards=2):
     return result
 
 
-if __name__ == "__main__":
-    argv = [a for a in sys.argv[1:] if a != "--chaos"]
-    if len(argv) < len(sys.argv) - 1:
-        run_chaos(argv[0] if argv else None)
+_TABLE_MARK = "<!-- TABLE:MULTICHIP_R06 -->"
+_TABLE_END = "<!-- /TABLE:MULTICHIP_R06 -->"
+
+
+def render_table(json_path, notes_path=None):
+    """Render the scaling table from a recorded result JSON into
+    docs/PERF_NOTES.md between the TABLE:MULTICHIP_R06 markers. Idempotent:
+    a previously rendered table (marker..end-marker) is replaced."""
+    repo = os.path.dirname(os.path.dirname(os.path.abspath(__file__)))
+    notes_path = notes_path or os.path.join(repo, "docs", "PERF_NOTES.md")
+    with open(json_path) as fh:
+        r = json.load(fh)
+    lines = [
+        f"Recorded from `{os.path.basename(json_path)}`: real "
+        f"{r['rows']:,}-row x {r['iters']}-iter training runs "
+        f"(`objective=binary`, L={r['num_leaves']}, B={r['max_bin']}) on "
+        f"backend=`{r['backend']}` with {r['devices']} virtual devices over "
+        f"{r['cores']} host core(s) — efficiency is "
+        f"speedup / min(shards, cores), i.e. on a 1-core host it measures "
+        f"pure sharding overhead (see `scripts/bench_multichip.py`).",
+        "",
+        "| shards | ingest (s) | compile+first iter (s) | iters/sec | "
+        "speedup | efficiency | tree hash == 1-shard |",
+        "|---|---|---|---|---|---|---|",
+    ]
+    for e in r["entries"]:
+        lines.append(
+            f"| {e['num_shards']} | {e['ingest_s']} | "
+            f"{e['compile_first_iter_s']} | {e['iters_per_sec']} | "
+            f"{e['speedup_vs_1shard']}x | {e['scaling_efficiency']} | "
+            f"{'yes' if e['tree_hash_equal_vs_1shard'] else 'NO'} |")
+    lines.append("")
+    lines.append(
+        f"All tree hashes equal across shard counts: "
+        f"**{'yes' if r['all_tree_hashes_equal'] else 'NO'}** "
+        f"(lattice-quantized gradients — bit-identity, not approximate "
+        f"parity); builtin-sigmoid max|Δpred| vs 1-shard = "
+        f"{r['max_abs_pred_delta_vs_1shard']:.2e}.")
+    table = "\n".join([_TABLE_MARK] + lines + [_TABLE_END])
+
+    with open(notes_path) as fh:
+        doc = fh.read()
+    if _TABLE_MARK not in doc:
+        raise SystemExit(f"{notes_path} has no {_TABLE_MARK} marker")
+    start = doc.index(_TABLE_MARK)
+    if _TABLE_END in doc:
+        end = doc.index(_TABLE_END) + len(_TABLE_END)
     else:
-        run(argv[0] if argv else None)
+        end = start + len(_TABLE_MARK)
+    doc = doc[:start] + table + doc[end:]
+    sys.path.insert(0, repo)
+    from lightgbm_tpu.utils.atomic_io import atomic_write_text
+    atomic_write_text(notes_path, doc)
+    print(f"# rendered {len(r['entries'])}-row scaling table into "
+          f"{notes_path}", file=sys.stderr)
+
+
+if __name__ == "__main__":
+    if "--render-table" in sys.argv:
+        argv = [a for a in sys.argv[1:] if a != "--render-table"]
+        render_table(argv[0] if argv else "MULTICHIP_r06.json")
+    else:
+        argv = [a for a in sys.argv[1:] if a != "--chaos"]
+        if len(argv) < len(sys.argv) - 1:
+            run_chaos(argv[0] if argv else None)
+        else:
+            run(argv[0] if argv else None)

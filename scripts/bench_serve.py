@@ -1,0 +1,191 @@
+"""Closed-loop serving bench: SERVE_BENCH.json.
+
+Measures the request-coalescing microbatcher (server.py) against the
+uncoalesced baseline it exists to beat — one device dispatch per single-row
+request (PREDICT_BENCH recorded that baseline at ~31 rows/s on the tunneled
+v5e: ~30ms of dispatch+transfer amortized over one row).
+
+Three sections:
+
+- ``uncoalesced``: sequential single-row ``PredictEngine.predict`` calls —
+  the per-dispatch floor on THIS backend (the honest denominator for the
+  coalescing win; the recorded TPU 31 rows/s is kept as a reference point).
+- ``load_points``: closed-loop sweep — N client threads, each submitting
+  single-row requests back-to-back for a fixed wall window. Per point:
+  achieved QPS, latency percentiles (p50/p99/p999), and the coalesce factor
+  (rows per device dispatch) from the scheduler's own telemetry.
+- ``overload``: graceful degradation — a tiny bounded queue is flooded with
+  async submits; the JSON records how many were shed (ServeOverload) vs
+  served, and that every ADMITTED request completed. Bounded queue =>
+  bounded latency; load beyond capacity fails fast instead of stretching
+  tails.
+
+Usage: python scripts/bench_serve.py [--quick] [out.json]
+Env: LGBM_TPU_SERVE_BENCH_SECONDS / _CLIENTS (comma list) / _ROWS / _ITERS
+"""
+import json
+import os
+import sys
+import threading
+import time
+
+sys.path.insert(0, os.path.dirname(os.path.dirname(os.path.abspath(__file__))))
+
+CLIENT_SWEEP = [int(c) for c in os.environ.get(
+    "LGBM_TPU_SERVE_BENCH_CLIENTS", "1,8,64").split(",")]
+SECONDS = float(os.environ.get("LGBM_TPU_SERVE_BENCH_SECONDS", 2.0))
+TRAIN_ROWS = int(os.environ.get("LGBM_TPU_SERVE_BENCH_ROWS", 20_000))
+TRAIN_ITERS = int(os.environ.get("LGBM_TPU_SERVE_BENCH_ITERS", 20))
+
+
+def _percentiles(lat):
+    import numpy as np
+    a = np.asarray(sorted(lat))
+    return {
+        "p50_ms": round(float(np.percentile(a, 50)) * 1e3, 4),
+        "p99_ms": round(float(np.percentile(a, 99)) * 1e3, 4),
+        "p999_ms": round(float(np.percentile(a, 99.9)) * 1e3, 4),
+        "max_ms": round(float(a[-1]) * 1e3, 4),
+    }
+
+
+def run(out_path=None, quick=False):
+    import numpy as np
+    import jax
+
+    import lightgbm_tpu as lgb
+    from lightgbm_tpu.server import PredictServer, ServeOverload
+
+    seconds = 0.5 if quick else SECONDS
+    rows = min(TRAIN_ROWS, 5_000) if quick else TRAIN_ROWS
+    iters = min(TRAIN_ITERS, 5) if quick else TRAIN_ITERS
+
+    from bench import synth_higgs
+    X, y = synth_higgs(rows)
+    params = {"objective": "binary", "num_leaves": 63, "max_bin": 63,
+              "learning_rate": 0.1, "verbose": -1, "prewarm": 0}
+    print(f"# training {rows} rows x {iters} iters...", file=sys.stderr)
+    booster = lgb.train(params, lgb.Dataset(X, label=y, params=params),
+                        num_boost_round=iters)
+    queries = X[:4096]
+
+    # ---- uncoalesced baseline: one dispatch per single-row request ----
+    srv = PredictServer({"verbose": -1}, model=booster)
+    eng = srv.registry.current().engine
+    for _ in range(5):
+        eng.predict(queries[:1])               # warm the n=1 bucket
+    t0 = time.perf_counter()
+    n_base = 0
+    while time.perf_counter() - t0 < min(seconds, 1.0):
+        eng.predict(queries[n_base % 1024: n_base % 1024 + 1])
+        n_base += 1
+    uncoalesced_rps = n_base / (time.perf_counter() - t0)
+    print(f"# uncoalesced single-row: {uncoalesced_rps:,.0f} rows/s",
+          file=sys.stderr)
+
+    # ---- closed-loop sweep ----
+    load_points = []
+    for n_clients in CLIENT_SWEEP:
+        st0 = srv.batcher.snapshot()
+        lat, errs = [], []
+        lat_lock = threading.Lock()
+        stop = threading.Event()
+        barrier = threading.Barrier(n_clients + 1)
+
+        def client(t):
+            my = []
+            try:
+                barrier.wait()
+                i = t
+                while not stop.is_set():
+                    q0 = time.perf_counter()
+                    srv.predict(queries[i % len(queries)], timeout=60)
+                    my.append(time.perf_counter() - q0)
+                    i += n_clients
+            except Exception as e:             # pragma: no cover
+                errs.append(repr(e))
+            with lat_lock:
+                lat.extend(my)
+
+        ths = [threading.Thread(target=client, args=(t,))
+               for t in range(n_clients)]
+        [t.start() for t in ths]
+        barrier.wait()
+        t0 = time.perf_counter()
+        time.sleep(seconds)
+        stop.set()
+        [t.join() for t in ths]
+        wall = time.perf_counter() - t0
+        st1 = srv.batcher.snapshot()
+        flushes = st1["flushes"] - st0["flushes"]
+        flushed = st1["flushed_rows"] - st0["flushed_rows"]
+        point = {
+            "clients": n_clients,
+            "requests": len(lat),
+            "wall_s": round(wall, 3),
+            "qps": round(len(lat) / wall, 1),
+            "coalesce_factor": round(flushed / flushes, 2) if flushes else 0.0,
+            "flushes": flushes,
+            "errors": errs[:3],
+            **_percentiles(lat),
+        }
+        load_points.append(point)
+        print(f"# {n_clients:3d} clients: {point['qps']:>9,.0f} qps  "
+              f"p50 {point['p50_ms']:.2f}ms  p99 {point['p99_ms']:.2f}ms  "
+              f"coalesce {point['coalesce_factor']}", file=sys.stderr)
+    srv.close()
+
+    # ---- overload: bounded queue sheds, admitted requests all complete ----
+    osrv = PredictServer({"verbose": -1, "serve_queue_max": 64,
+                          "serve_batch_window_us": 2000}, model=booster)
+    shed = admitted = 0
+    reqs = []
+    for i in range(2000):
+        try:
+            reqs.append(osrv.batcher.submit_async(queries[i % 1024]))
+            admitted += 1
+        except ServeOverload:
+            shed += 1
+    served = sum(1 for r in reqs if r.result(timeout=60) is not None)
+    odepth = osrv.batcher.snapshot()["max_queue_depth"]
+    osrv.close()
+    overload = {
+        "offered": 2000, "queue_max": 64, "admitted": admitted,
+        "shed": shed, "served_of_admitted": served,
+        "max_queue_depth": odepth,
+        "all_admitted_served": served == admitted,
+    }
+    print(f"# overload: {shed}/2000 shed, {served}/{admitted} admitted "
+          f"served, max depth {odepth}", file=sys.stderr)
+
+    best_qps = max(p["qps"] for p in load_points)
+    p64 = next((p for p in load_points if p["clients"] == 64), None)
+    result = {
+        "bench": "serve_microbatch",
+        "backend": jax.default_backend(),
+        "devices": jax.device_count(),
+        "cores": os.cpu_count() or 1,
+        "quick": bool(quick),
+        "model": {"rows": rows, "iters": iters, "num_leaves": 63,
+                  "max_bin": 63, "features": int(X.shape[1])},
+        "seconds_per_point": seconds,
+        "uncoalesced_single_row_rps": round(uncoalesced_rps, 1),
+        "recorded_tpu_uncoalesced_rps": 31.0,
+        "load_points": load_points,
+        "overload": overload,
+        "best_qps": best_qps,
+        "speedup_vs_uncoalesced": round(best_qps / uncoalesced_rps, 2),
+        "speedup_vs_recorded_31rps": round(best_qps / 31.0, 1),
+        "qps_64_clients": p64["qps"] if p64 else None,
+    }
+    doc = json.dumps(result, indent=2)
+    if out_path:
+        from lightgbm_tpu.utils.atomic_io import atomic_write_text
+        atomic_write_text(out_path, doc + "\n")
+    print(doc)
+    return result
+
+
+if __name__ == "__main__":
+    argv = [a for a in sys.argv[1:] if a != "--quick"]
+    run(argv[0] if argv else None, quick=len(argv) < len(sys.argv) - 1)

@@ -676,3 +676,101 @@ def test_schema_shim_still_works():
                           text=True, timeout=120)
     assert proc.returncode == 0, proc.stdout + proc.stderr
     assert "PASS" in proc.stdout
+
+
+# ---- serving-scheduler rule scopes (PR: online serving) ----
+# server.py (the microbatch scheduler) is multi-threaded, so both threading
+# rules extend their scope to it, and the scheduler loop gets a stricter
+# audit: blocking-call-in-scheduler-loop — one thread drains the shared
+# request queue, so ANY blocking call there (time.sleep, unbounded .join(),
+# .get() with no timeout) stalls every queued request, not just its own.
+
+SERVER_REL = "lightgbm_tpu/server.py"
+
+SCHED_LOOP_BAD = """
+import time
+
+def _scheduler_loop(self):
+    while True:
+        req = self._q.get()
+        time.sleep(0.001)
+        self._worker.join()
+        self._flush([req])
+"""
+
+SCHED_LOOP_SUPPRESSED = """
+import time
+
+def _scheduler_loop(self):
+    while True:
+        req = self._q.get(timeout=0.05)
+        # single-request debug build: the pause IS the batching window
+        time.sleep(0.001)   # tpu-lint: disable=host-sync-in-jit
+        self._flush([req])
+"""
+
+SCHED_LOOP_CLEAN = """
+import queue
+
+def _scheduler_loop(self):
+    while True:
+        try:
+            req = self._q.get(timeout=0.05)
+        except queue.Empty:
+            continue
+        try:
+            nxt = self._q.get_nowait()
+        except queue.Empty:
+            nxt = None
+        self._flush([r for r in (req, nxt) if r is not None])
+"""
+
+
+def test_scheduler_loop_blocking_calls_fire():
+    found = names(analyze_source(SCHED_LOOP_BAD, relpath=SERVER_REL))
+    assert "host-sync-in-jit" in found
+    msgs = [f.message for f in analyze_source(SCHED_LOOP_BAD,
+                                              relpath=SERVER_REL)
+            if f.rule == "host-sync-in-jit"]
+    # all three blocking shapes are called out: sleep, bare join, bare get
+    assert any("sleep" in m for m in msgs), msgs
+    assert any(".join()" in m for m in msgs), msgs
+    assert any(".get()" in m for m in msgs), msgs
+    # the very same loop body outside the designated module is not audited
+    assert "host-sync-in-jit" not in names(
+        analyze_source(SCHED_LOOP_BAD, relpath="lightgbm_tpu/engine.py"))
+
+
+def test_scheduler_loop_suppressed_and_clean():
+    assert "host-sync-in-jit" not in names(
+        analyze_source(SCHED_LOOP_SUPPRESSED, relpath=SERVER_REL))
+    kept = analyze_source(SCHED_LOOP_SUPPRESSED, relpath=SERVER_REL,
+                          keep_suppressed=True)
+    assert "host-sync-in-jit" in names(kept)
+    assert "host-sync-in-jit" not in names(
+        analyze_source(SCHED_LOOP_CLEAN, relpath=SERVER_REL))
+
+
+SERVER_SHARED_BAD = """
+_LAST_SERVER = {}
+
+def remember(srv):
+    _LAST_SERVER["srv"] = srv
+"""
+
+SERVER_SHARED_LOCKED = """
+import threading
+_LAST_SERVER = {}
+_LOCK = threading.Lock()
+
+def remember(srv):
+    with _LOCK:
+        _LAST_SERVER["srv"] = srv
+"""
+
+
+def test_server_module_in_shared_state_scope():
+    assert "unlocked-shared-state" in names(
+        analyze_source(SERVER_SHARED_BAD, relpath=SERVER_REL))
+    assert "unlocked-shared-state" not in names(
+        analyze_source(SERVER_SHARED_LOCKED, relpath=SERVER_REL))
