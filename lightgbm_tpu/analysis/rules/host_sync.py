@@ -49,6 +49,7 @@ HOT_LOOPS: Set[Tuple[str, str]] = {
     ("lightgbm_tpu/ingest.py", "_h2d_loop"),
     ("lightgbm_tpu/ingest.py", "_commit_loop"),
     ("lightgbm_tpu/server.py", "_scheduler_loop"),
+    ("lightgbm_tpu/online.py", "run"),
 }
 
 # scheduler loops (server.py MicroBatcher): ONE thread drains the shared
@@ -59,6 +60,9 @@ HOT_LOOPS: Set[Tuple[str, str]] = {
 # waiting happens on the queue, bounded, interruptible.
 SCHED_LOOPS: Set[Tuple[str, str]] = {
     ("lightgbm_tpu/server.py", "_scheduler_loop"),
+    # the online feed loop drains a shared source the same way: a bare
+    # sleep / un-timed get there stalls every buffered batch behind it
+    ("lightgbm_tpu/online.py", "run"),
 }
 
 

@@ -36,7 +36,7 @@ from ..core import ModuleContext, Rule, register, root_name
 # the serving engine + microbatch scheduler, the obs sinks, and the chunked
 # ingest pipeline
 _SCOPE_FILES = ("lightgbm_tpu/serving.py", "lightgbm_tpu/server.py",
-                "lightgbm_tpu/ingest.py")
+                "lightgbm_tpu/ingest.py", "lightgbm_tpu/online.py")
 _SCOPE_DIRS = ("lightgbm_tpu/obs/",)
 _MUTATING_METHODS = {"append", "extend", "add", "update", "setdefault",
                      "pop", "popitem", "clear", "remove", "insert",

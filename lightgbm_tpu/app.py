@@ -256,6 +256,60 @@ def run_serve(conf: Config, params: Dict) -> None:
             log.info("telemetry exported to %s", exported)
 
 
+def run_online(conf: Config, params: Dict) -> None:
+    """task=online: continuous training (online.py). Train an initial model
+    on ``data`` (or load ``input_model``), then tail ``online_feed`` for
+    label-first rows, appending them to the Dataset under its frozen bin
+    boundaries and refitting/publishing per the ``online_*`` triggers.
+
+    With ``serve_port > 0`` the hot-swapping PredictServer serves the
+    newline protocol on that port concurrently (``!learn`` lines feed the
+    same trainer) and the feed file is followed until interrupted; with no
+    port the feed is drained once and the final model saved — a batch
+    catch-up job."""
+    import threading
+    if not conf.data:
+        log.fatal("No training data: set data=<file>")
+    if not conf.online_feed:
+        log.fatal("No streaming feed: set online_feed=<file>")
+    train_set = _load_dataset(conf.data, conf, params,
+                              initscore_path=conf.initscore_filename)
+    if conf.input_model:
+        booster = Booster(model_file=conf.input_model, params=params)
+    else:
+        booster = engine_train(params, train_set,
+                               num_boost_round=conf.num_iterations)
+    from .online import OnlineTrainer, tail_source
+    from .server import PredictServer, serve_tcp
+    server = PredictServer(conf, model=booster)
+    trainer = OnlineTrainer(params, train_set, booster=booster,
+                            server=server)
+    server.attach_online(trainer)
+    stop = threading.Event()
+    follow = conf.serve_port > 0
+    if follow:
+        threading.Thread(target=serve_tcp,
+                         args=(server, "0.0.0.0", conf.serve_port),
+                         daemon=True).start()
+    try:
+        fed = trainer.run(tail_source(conf.online_feed, stop=stop,
+                                      follow=follow), stop=stop)
+        log.info(f"online: fed {fed} rows over {trainer.cycles} refit "
+                 f"cycles (version {trainer.version})")
+    except KeyboardInterrupt:
+        stop.set()
+        log.info("online: interrupted; flushing pending rows")
+        trainer.flush()
+    finally:
+        server.close()
+        trainer.booster.save_model(conf.output_model)
+        log.info(f"Finished online training; model saved to "
+                 f"{conf.output_model}")
+        exported = obs.export_all(conf.metrics_out)
+        if exported:
+            log.info("telemetry exported to %s", exported)
+
+
 def run_convert_model(conf: Config, params: Dict) -> None:
     if not conf.input_model:
         log.fatal("No model file: set input_model=<file>")
@@ -292,6 +346,8 @@ def main(argv: List[str]) -> int:
         run_convert_model(conf, params)
     elif task == "serve":
         run_serve(conf, params)
+    elif task == "online":
+        run_online(conf, params)
     else:
         log.fatal(f"Unknown task: {task}")
     return 0

@@ -588,6 +588,146 @@ class Dataset:
         ds._constructed = True
         return ds
 
+    def append(self, data, label=None, weight=None, group=None,
+               init_score=None) -> "Dataset":
+        """Append fresh rows to a CONSTRUCTED dataset under FROZEN binning.
+
+        The continuous-training growth path (reference analog: the refit /
+        continued-training data flow around GBDT::RefitTree + train-from-
+        init-model): new rows are re-binned against the bin boundaries, the
+        used-feature map and the EFB bundle plan fixed at the original
+        ``construct()`` — ``find_bins`` never reruns, so a model trained on
+        the original rows keeps meaning the same thing on the grown matrix.
+        Out-of-range values clip to the edge bins and unseen categories land
+        in bin 0, exactly like a ``reference=``-aligned validation set.
+
+        The fresh rows stream through the same three-stage ingest pipeline
+        as construct (chunked host encode -> H2D -> donated device commit),
+        with the encode stage swapped for the frozen re-encoder. Under a
+        ``RowShardPlan`` the row grid is re-planned for the grown total over
+        the same shard count and the matrix is redistributed onto it, so the
+        trainer's shard_map keeps one contiguous-block layout.
+
+        Trainers and Boosters created BEFORE an append hold the old device
+        matrix (the fused step captures its padded shape); build a new one
+        (or ``train(init_model=...)``) after appending — the online loop in
+        ``lightgbm_tpu.online`` does exactly that.
+        """
+        self.construct()
+        if _is_scipy_sparse(data):
+            log.fatal("Dataset.append does not support sparse input; "
+                      "densify the appended rows")
+        conf = params_to_config(self.params)
+        raw = _to_numpy_2d(data, self.pandas_categorical)
+        n_new = int(raw.shape[0])
+        if n_new == 0:
+            return self
+        if self._num_features_raw is not None and \
+                raw.shape[1] != self._num_features_raw:
+            log.fatal(f"Dataset.append: appended rows have {raw.shape[1]} "
+                      f"features, dataset was constructed with "
+                      f"{self._num_features_raw}")
+        label_new = _to_numpy_1d(label)
+        weight_new = _to_numpy_1d(weight)
+        isc_new = _to_numpy_1d(init_score)
+        old_n = int(self._num_data)
+        for name, have, got, want in (
+                ("label", self.label is not None, label_new, n_new),
+                ("weight", self.weight is not None, weight_new, n_new)):
+            if have and got is None:
+                log.fatal(f"Dataset.append: dataset has {name} but appended "
+                          f"rows do not")
+            if not have and got is not None:
+                log.fatal(f"Dataset.append: appended rows carry {name} but "
+                          f"the dataset has none")
+            if got is not None and len(got) != want:
+                log.fatal(f"Dataset.append: {name} has {len(got)} entries "
+                          f"for {want} appended rows")
+        if self.group is not None and group is None:
+            log.fatal("Dataset.append: dataset has group boundaries; appended "
+                      "rows must supply their own group")
+
+        from . import obs
+        from .efb import apply_bundles
+        from .binning import rebin_frozen
+        from .ingest import last_stats, stream_encode_upload
+        t0 = time.time()
+        used = raw[:, self.feature_map] if self.feature_map is not None \
+            else raw
+        mappers, meta = self.mappers, self.bundle_meta
+
+        def _frozen_encode(chunk):
+            cb = rebin_frozen(chunk, mappers)
+            return apply_bundles(cb, meta) if meta is not None else cb
+
+        width = int(self._num_features_used)
+        # the pipeline sees the already-column-selected matrix; mappers/meta
+        # ride along only for the default encode path it will not take
+        new_dev = stream_encode_upload(
+            used, mappers, meta, width=width,
+            chunk_rows=conf.ingest_chunk_rows,
+            encode_threads=conf.encode_threads, encode_fn=_frozen_encode)
+        chunks = int(last_stats().get("chunks", 0))
+        n_total = old_n + n_new
+        old_plan = self.shard_plan
+        resharded = False
+        full = jnp.concatenate([self.bins[:old_n], new_dev], axis=0)
+        if old_plan is not None:
+            # same shard count, grown row total: every row's owner moves, so
+            # redistribute onto the re-planned contiguous-block grid (the
+            # trainer's shard_map and histogram psum key on this layout)
+            from .parallel.mesh import plan_row_sharding
+            plan = plan_row_sharding(n_total, old_plan.num_shards,
+                                     axis_name=old_plan.axis_name)
+            if plan is not None:
+                pad = plan.n_padded - n_total
+                if pad:
+                    full = jnp.concatenate(
+                        [full, jnp.zeros((pad, width), jnp.uint8)], axis=0)
+                full = jax.device_put(full, plan.sharding(2))
+                resharded = True
+            self.shard_plan = plan
+        self.bins = full
+        if self.label is not None:
+            self.label = jnp.concatenate(
+                [jnp.asarray(self.label)[:old_n],
+                 jax.device_put(np.asarray(label_new, np.float32))])
+        if self.weight is not None:
+            self.weight = jnp.concatenate(
+                [jnp.asarray(self.weight)[:old_n],
+                 jax.device_put(np.asarray(weight_new, np.float32))])
+        if group is not None:
+            g_new = np.asarray(group, dtype=np.int64)
+            if int(g_new.sum()) != n_new:
+                log.fatal(f"Dataset.append: group sums to {int(g_new.sum())} "
+                          f"but {n_new} rows were appended")
+            self.group = (np.concatenate([self.group, g_new])
+                          if self.group is not None else g_new)
+        if self.init_score is not None or isc_new is not None:
+            old_isc = (np.asarray(self.init_score)
+                       if self.init_score is not None else None)
+            if old_isc is None or isc_new is None:
+                log.fatal("Dataset.append: init_score must be supplied on "
+                          "both the dataset and the appended rows, or "
+                          "neither")
+            # multiclass init_score is stored flat [n*k]
+            k = old_isc.size // max(old_n, 1)
+            if old_isc.size != old_n * k or isc_new.size != n_new * k:
+                log.fatal(f"Dataset.append: init_score size {isc_new.size} "
+                          f"does not match {n_new} rows x {k} classes")
+            self.init_score = np.concatenate(
+                [old_isc.reshape(old_n, k), isc_new.reshape(n_new, k)],
+                axis=0).reshape(-1)
+        self._num_data = n_total
+        if obs.enabled():
+            obs.emit("dataset_append", rows=int(n_new),
+                     total_rows=int(n_total), chunks=chunks,
+                     duration_s=time.time() - t0,
+                     num_shards=(self.shard_plan.num_shards
+                                 if self.shard_plan is not None else 1),
+                     resharded=resharded)
+        return self
+
     # ---- accessors (reference Dataset API surface) ----
     @property
     def num_data(self) -> int:

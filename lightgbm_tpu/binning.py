@@ -548,3 +548,25 @@ def bin_data(
         raw_num_features=f,
         feature_map=np.array(used, dtype=np.int32),
     )
+
+
+def rebin_frozen(data: np.ndarray, mappers: List[BinMapper]) -> np.ndarray:
+    """Encode fresh rows against FROZEN mappers (no re-``find_bins``).
+
+    The continuous-training append path: ``data`` is the already
+    column-selected raw matrix (``raw[:, feature_map]``) and ``mappers`` are a
+    constructed Dataset's stored (used-only) mappers — one per column, trivial
+    or not, so no column may be re-dropped here. Values the original sample
+    never saw clip to the edge bins (``values_to_bins`` searchsorted caps at
+    the last numeric bin; unseen categories land in bin 0), exactly like the
+    ``reference=`` construct path, so appended bins are bit-identical to a
+    one-shot construct of the concatenated data.
+    """
+    data = np.asarray(data, dtype=np.float64)
+    if data.ndim != 2 or data.shape[1] != len(mappers):
+        raise ValueError(
+            f"rebin_frozen: expected [n, {len(mappers)}] used-feature matrix, "
+            f"got shape {data.shape}")
+    # keep_trivial=True: column k must encode with mappers[k] verbatim — the
+    # frozen plan already dropped trivials at original construct time
+    return bin_data(data, mappers, keep_trivial=True).bins

@@ -230,3 +230,52 @@ def server_close(server) -> int:
     """Drain queued requests, stop the scheduler thread."""
     server.close()
     return 0
+
+
+# ---- continuous training (online.py; reference analog: LGBM_BoosterRefit,
+# c_api.h:652 — ours additionally grows the Dataset in place under frozen
+# bin boundaries and hot-swaps each refit version into the server) ----
+
+def dataset_append(ds, data_addr: int, nrow: int, ncol: int,
+                   label_addr: int) -> int:
+    """Append dense f64 rows (+ labels) to a CONSTRUCTED Dataset under its
+    frozen bin boundaries and EFB plan (basic.Dataset.append). Returns the
+    new total row count. The buffer is copied, like dataset_from_mat."""
+    src = (ctypes.c_double * (nrow * ncol)).from_address(data_addr)
+    x = np.frombuffer(src, dtype=np.float64).reshape(nrow, ncol).copy()
+    label = None
+    if label_addr:
+        lsrc = (ctypes.c_double * nrow).from_address(label_addr)
+        label = np.frombuffer(lsrc, dtype=np.float64).copy()
+    ds.append(x, label=label)
+    return int(ds.num_data)
+
+
+def online_create(ds, booster, server, params_str: str):
+    """Opaque OnlineTrainer handle bound to a Dataset + current model; when
+    ``server`` is non-None each refit cycle hot-swaps into its registry and
+    the serve protocol's ``!learn`` lines feed this trainer."""
+    from .online import OnlineTrainer
+    trainer = OnlineTrainer(_parse_params(params_str), ds, booster=booster,
+                            server=server)
+    if server is not None:
+        server.attach_online(trainer)
+    return trainer
+
+
+def online_feed(trainer, data_addr: int, nrow: int, ncol: int,
+                label_addr: int) -> int:
+    """Feed one labeled batch; returns the newly published model version
+    when this batch triggered a refit cycle, else 0."""
+    src = (ctypes.c_double * (nrow * ncol)).from_address(data_addr)
+    x = np.frombuffer(src, dtype=np.float64).reshape(nrow, ncol).copy()
+    lsrc = (ctypes.c_double * nrow).from_address(label_addr)
+    label = np.frombuffer(lsrc, dtype=np.float64).copy()
+    version = trainer.feed(x, label)
+    return int(version or 0)
+
+
+def online_flush(trainer) -> int:
+    """Force one refit cycle on whatever rows pend; returns the published
+    version, or 0 when nothing pended."""
+    return int(trainer.flush() or 0)

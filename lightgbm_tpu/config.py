@@ -223,6 +223,22 @@ _PARAMS: Dict[str, Tuple[Any, Tuple[str, ...]]] = {
     # task=serve transport: 0 = stdio line protocol, >0 = threaded TCP
     # server on this port
     "serve_port": (0, ()),
+    # ---- continuous training (task=online; see lightgbm_tpu/online.py) ----
+    # refit trigger: once this many fresh rows are buffered, append them to
+    # the Dataset, refit/continue training, and publish the new version
+    "online_refit_rows": (10000, ("refit_rows",)),
+    # drift trigger: refit early when the serving model's eval metric on an
+    # incoming batch worsens by more than this vs the baseline recorded at
+    # the previous (re)fit (0 = row-count trigger only)
+    "online_drift_metric_delta": (0.0, ("drift_metric_delta",)),
+    # boosting rounds added per refit cycle: 0 = leaf-output refit only
+    # (reference RefitTree semantics — tree structures frozen), N > 0 =
+    # continued training (train(init_model=...)) for N extra rounds
+    "online_boost_rounds": (0, ()),
+    # task=online: file of label-first rows ("<label>,<v1>,...") to tail as
+    # the streaming feed; followed until interrupted when serve_port > 0,
+    # else drained once (batch catch-up) and the final model saved
+    "online_feed": ("", ("online_feed_file",)),
     # ---- observability (new in this framework; see lightgbm_tpu/obs/) ----
     # structured telemetry: schema'd events + metrics around the hot paths;
     # LGBMTPU_TELEMETRY=0/1 env overrides the param in either direction
@@ -370,6 +386,13 @@ class Config:
             log.fatal("serve_max_batch_rows must be >= 1")
         if not 0 <= self.serve_port <= 65535:
             log.fatal(f"serve_port must be in [0, 65535], got {self.serve_port}")
+        if self.online_refit_rows < 1:
+            log.fatal("online_refit_rows must be >= 1")
+        if self.online_drift_metric_delta < 0:
+            log.fatal("online_drift_metric_delta must be >= 0 (0 = row-count "
+                      "trigger only)")
+        if self.online_boost_rounds < 0:
+            log.fatal("online_boost_rounds must be >= 0 (0 = leaf refit only)")
 
     def to_dict(self) -> Dict[str, Any]:
         out = {name: getattr(self, name) for name in _PARAMS}

@@ -17,10 +17,19 @@ from . import callback as cb
 from . import obs
 from . import snapshot as snap
 from .basic import Booster, Dataset
-from .config import Config, params_to_config
+from .config import Config, canonical_name, params_to_config
 from .obs import tracing
 from .utils import faults, log
 from .utils.timer import TIMER
+
+
+def _iterations_set_in_params(params: Dict[str, Any]) -> bool:
+    """True when the caller spelled out the iteration count in ``params``
+    (under any of ``num_iterations``' aliases). Mirrors the reference
+    python-package's ``_choose_param_value`` precedence: an explicit params
+    entry wins over the ``num_boost_round`` keyword default — checked via
+    the alias table, not by comparing values against the default."""
+    return any(canonical_name(str(k)) == "num_iterations" for k in params)
 
 
 def train(params: Dict[str, Any], train_set: Dataset,
@@ -57,7 +66,7 @@ def train(params: Dict[str, Any], train_set: Dataset,
     TIMER.begin_run()
     if conf.faults:
         faults.configure(conf.faults)
-    if conf.num_iterations != 100 and num_boost_round == 100:
+    if _iterations_set_in_params(params):
         num_boost_round = conf.num_iterations
     if conf.early_stopping_round and early_stopping_rounds is None:
         early_stopping_rounds = conf.early_stopping_round
@@ -376,7 +385,7 @@ def cv(params: Dict[str, Any], train_set: Dataset, num_boost_round: int = 100,
     if metrics is not None:
         params["metric"] = metrics
     conf = params_to_config(params)
-    if conf.num_iterations != 100 and num_boost_round == 100:
+    if _iterations_set_in_params(params):
         num_boost_round = conf.num_iterations
     ranking = conf.objective in ("lambdarank", "rank_xendcg", "xendcg",
                                  "xe_ndcg", "xe_ndcg_mart", "rank_xendcg_mart")

@@ -121,7 +121,7 @@ def overlap_efficiency(stage_spans, wall_s: float) -> float:
 def stream_encode_upload(raw, mappers, meta, *, width: int,
                          chunk_rows: int, encode_threads: int = 0,
                          phases: Optional[Dict[str, Any]] = None,
-                         shard_plan=None):
+                         shard_plan=None, encode_fn=None):
     """Run the three-stage pipeline over ``raw`` [N, F_raw] and return the
     device bin matrix: [N, width] uint8 on one device, or — with a
     ``shard_plan`` (parallel/mesh.RowShardPlan) — a global
@@ -131,6 +131,14 @@ def stream_encode_upload(raw, mappers, meta, *, width: int,
     applied per chunk inside the encode stage so the unbundled matrix never
     exists on device. ``phases`` (optional dict) receives the disjoint
     per-stage busy breakdown + ``overlap_efficiency``.
+
+    ``encode_fn`` (optional) replaces the default encode stage body: it is
+    called as ``encode_fn(raw[g0:g1])`` and must return the FINAL
+    [rows, width] uint8 chunk (any EFB bundling already applied). The
+    continuous-training append path uses it to re-bin fresh rows against a
+    constructed Dataset's frozen mappers (``binning.rebin_frozen``) instead
+    of re-deriving used columns from scratch; the function must be pure and
+    thread-safe — it runs concurrently on the encode pool.
     """
     from .efb import apply_bundles
 
@@ -182,9 +190,12 @@ def stream_encode_upload(raw, mappers, meta, *, width: int,
                     continue   # drain remaining work items without encoding
             try:
                 t0 = time.perf_counter()
-                cb = bin_data(raw[g0:g1], mappers).bins
-                if meta is not None:
-                    cb = apply_bundles(cb, meta)
+                if encode_fn is not None:
+                    cb = encode_fn(raw[g0:g1])
+                else:
+                    cb = bin_data(raw[g0:g1], mappers).bins
+                    if meta is not None:
+                        cb = apply_bundles(cb, meta)
                 cb = np.ascontiguousarray(cb)
                 dt = time.perf_counter() - t0
                 with lock:
@@ -377,7 +388,7 @@ def stream_with_recovery(raw, mappers, meta, *, width: int, chunk_rows: int,
                          encode_threads: int = 0,
                          phases: Optional[Dict[str, Any]] = None,
                          shard_plan=None, policy: str = "reshard",
-                         sleep=time.sleep):
+                         sleep=time.sleep, encode_fn=None):
     """:func:`stream_encode_upload` with OOM-adaptive degradation.
 
     A device-level fault during the pipeline (XLA ``RESOURCE_EXHAUSTED`` on
@@ -413,7 +424,7 @@ def stream_with_recovery(raw, mappers, meta, *, width: int, chunk_rows: int,
             bins = stream_encode_upload(
                 raw, mappers, meta, width=width, chunk_rows=rows,
                 encode_threads=encode_threads, phases=phases,
-                shard_plan=plan)
+                shard_plan=plan, encode_fn=encode_fn)
             return bins, plan, rows
         except BaseException as e:
             if policy == "fatal" or not faults.is_device_fault(e):

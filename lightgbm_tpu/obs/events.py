@@ -106,6 +106,25 @@ EVENT_SCHEMAS: Dict[str, Tuple[Dict[str, Any], Dict[str, Any]]] = {
     "mesh_preflight": ({"shards": int, "ok": bool},
                        {"devices": int, "mismatched_fields": int,
                         "error": str}),
+    # fresh rows were appended to a constructed Dataset under its frozen bin
+    # boundaries + EFB plan (basic.Dataset.append); resharded marks a
+    # shard-grid re-plan + redistribution for the grown row total
+    "dataset_append": ({"rows": int, "total_rows": int},
+                       {"chunks": int, "duration_s": _NUM, "num_shards": int,
+                        "resharded": bool}),
+    # one continuous-training refit cycle completed (online.OnlineTrainer):
+    # trigger is "rows" / "drift" / "manual" / "flush"; mode is "refit"
+    # (leaf-output refit) or "boost" (continued training); publish_s is the
+    # registry publish (engine build + warm) portion of duration_s
+    "online_refit": ({"trigger": str, "rows": int, "version": int},
+                     {"duration_s": _NUM, "mode": str, "iteration": int,
+                      "publish_s": _NUM}),
+    # the eval-metric drift watchdog fired: the current model's metric on
+    # the incoming batch drifted past online_drift_metric_delta from the
+    # baseline recorded at the previous (re)fit
+    "drift_trigger": ({"metric": str, "baseline": _NUM, "current": _NUM,
+                       "delta": _NUM},
+                      {"rows": int}),
 }
 
 

@@ -1,0 +1,336 @@
+"""Continuous training: append-only Dataset growth -> streaming refit ->
+zero-downtime hot-swap publish.
+
+The reference ships the pieces separately — ``task=refit`` re-fits leaf
+outputs (GBDT::RefitTree, gbdt.cpp:299) and continued training warm-starts
+from an init model (boosting.h CreateBoosting + the python package's
+``train(init_model=...)``) — but nothing closes the loop against live
+traffic. This module is that loop:
+
+1. rows arrive in batches (a callable, an iterator, a tailed CSV file, or
+   the serve protocol's ``!learn`` lines) and buffer in
+   :class:`OnlineTrainer`;
+2. a trigger fires — pending rows reached ``online_refit_rows``, the live
+   model's eval metric drifted by more than ``online_drift_metric_delta``
+   against the baseline recorded at the previous (re)fit, or an explicit
+   :meth:`OnlineTrainer.flush` — and the pending rows stream into the
+   training Dataset through :meth:`Dataset.append` (frozen bin boundaries +
+   EFB plan, the chunked 3-stage ingest pipeline, shard-plan-aware);
+3. the model updates — ``online_boost_rounds > 0`` continues boosting from
+   the current model (``train(init_model=...)``; the delta trees are merged
+   back into one servable model by :func:`merge_boosters`), else the leaf
+   outputs of the existing tree structures are refit on the fresh rows
+   (``Booster.refit``);
+4. the new version publishes into the serving :class:`~.server.ModelRegistry`
+   (engine built + warmed off the hot path, atomic pointer swap), so
+   in-flight predict requests finish on their version and new ones see the
+   refit model with zero dropped requests.
+
+Thread-safety: ``feed``/``flush`` may be called from any thread (the serve
+TCP handler threads do); all trainer state is guarded by one reentrant lock,
+and a refit cycle holds it end-to-end so concurrent feeds order cleanly
+around the dataset append + model swap. The module-level cycle stats mirror
+``ingest.LAST_INGEST_STATS`` and take their own lock.
+"""
+from __future__ import annotations
+
+import threading
+import time
+from typing import Any, Dict, List, Optional
+
+import numpy as np
+
+from . import obs
+from .basic import Booster, Dataset
+from .config import canonical_name, params_to_config
+from .metrics import create_metrics, default_metric_for_objective
+from .utils import log
+
+# last completed refit cycle (bench + test introspection); written under
+# _STATS_LOCK only — trainer threads and bench readers race otherwise
+_STATS_LOCK = threading.Lock()
+LAST_CYCLE_STATS: Dict[str, Any] = {}
+
+# sentinel a callable source returns to end the run loop (None means
+# "nothing right now, poll again")
+STOP = object()
+
+
+def last_cycle_stats() -> Dict[str, Any]:
+    with _STATS_LOCK:
+        return dict(LAST_CYCLE_STATS)
+
+
+def merge_boosters(init_model: Booster, delta: Booster) -> Booster:
+    """One servable Booster holding ``init_model``'s trees followed by
+    ``delta``'s.
+
+    ``train(init_model=...)`` returns only the delta trees — the init
+    model's contribution is baked into the warm-start scores, so the delta
+    alone underpredicts (see tests/test_engine.py::test_continued_training:
+    full prediction = init + delta). Serving needs a single artifact, so the
+    merge round-trips the init model through its text form (thresholds and
+    leaf values print at %.17g — exact f64 round-trip, io/model_text.py) and
+    appends the delta's host trees. The init model's first-tree bias folding
+    is already in its serialized leaf values; the warm-started delta skipped
+    ``boost_from_average``, so plain tree-sum prediction of the merged model
+    equals ``init.predict(x) + delta.predict(x)`` bit-for-bit."""
+    k = init_model.num_model_per_iteration()
+    params = dict(init_model.params)
+    if k > 1:
+        # dump_model_text reads num_class off the live config, which a
+        # model_str-constructed Booster would otherwise default to 1
+        params["num_class"] = k
+    merged = Booster(params=params,
+                     model_str=init_model.model_to_string(num_iteration=-1))
+    merged.trees = list(merged.trees) + list(delta._ensure_host_trees())
+    return merged
+
+
+def tail_source(path: str, stop: Optional[threading.Event] = None,
+                poll_s: float = 0.2, follow: bool = True,
+                from_start: bool = True):
+    """Generator over ``(X, y)`` batches appended to a text file of
+    label-first rows (``<label>,<v1>,<v2>,...``, comma or whitespace
+    separated — the CLI ``label_index=0`` convention).
+
+    Yields ``None`` when caught up with the file (the consumer's run loop
+    does the bounded waiting — this generator never sleeps), and returns
+    when ``follow=False`` and the end of the file is reached, or when
+    ``stop`` is set."""
+    stop_ev = stop if stop is not None else threading.Event()
+    with open(path, "r") as fh:
+        if not from_start:
+            fh.seek(0, 2)
+        while not stop_ev.is_set():
+            lines = fh.readlines()
+            if not lines:
+                if not follow:
+                    return
+                yield None
+                continue
+            rows = []
+            for ln in lines:
+                ln = ln.split("#", 1)[0].strip()
+                if ln:
+                    rows.append([float(t)
+                                 for t in ln.replace(",", " ").split()])
+            if rows:
+                arr = np.asarray(rows, dtype=np.float64)
+                yield arr[:, 1:], arr[:, 0]
+
+
+class OnlineTrainer:
+    """The continuous-training loop: buffer -> trigger -> append -> refit ->
+    publish.
+
+    >>> trainer = OnlineTrainer(params, dataset, booster=bst, server=srv)
+    >>> trainer.feed(X_batch, y_batch)        # buffers; may trigger a cycle
+    >>> trainer.flush()                       # force one cycle now
+    >>> trainer.run(tail_source("feed.csv"))  # or drive from a source
+
+    ``params`` knobs (config.py):
+      online_refit_rows         trigger a cycle once this many rows pend
+      online_drift_metric_delta >0: also trigger when the live model's first
+                                configured metric worsens by more than this
+                                on an incoming batch vs the baseline taken
+                                at the previous (re)fit
+      online_boost_rounds       >0: continue boosting this many rounds per
+                                cycle (mode "boost"); 0: leaf-output refit
+                                of the existing structures (mode "refit")
+
+    When ``booster`` is None an initial model is trained on ``dataset``
+    (``num_iterations`` rounds). When a server/registry is given, the
+    initial model is published only if the name has no current version —
+    ``PredictServer(model=...)`` already published it as v1.
+    """
+
+    def __init__(self, params: Optional[Dict] = None,
+                 dataset: Optional[Dataset] = None,
+                 booster: Optional[Booster] = None,
+                 server=None, registry=None, name: str = "default"):
+        if dataset is None:
+            log.fatal("OnlineTrainer needs the growing training Dataset")
+        self.params = dict(params or {})
+        self.conf = params_to_config(self.params)
+        self.dataset = dataset
+        self.server = server
+        self.registry = registry if registry is not None else \
+            (server.registry if server is not None else None)
+        self.name = name
+        self._lock = threading.RLock()
+        self._pend_x: List[np.ndarray] = []
+        self._pend_y: List[np.ndarray] = []
+        self._pend_w: List[np.ndarray] = []
+        self._baseline: Optional[float] = None
+        self.pending_rows = 0
+        self.cycles = 0
+        self.version = 0
+        mnames = self.conf.metric or \
+            [default_metric_for_objective(self.conf.objective)]
+        ms = create_metrics(mnames[:1], self.conf, self.conf.objective)
+        # group metrics (ndcg/map) need query boundaries feed() doesn't
+        # carry; drift watching is for the pointwise metric families
+        self._metric = ms[0] if ms and ms[0].eval_at is None else None
+        if booster is None:
+            from .engine import train as _train
+            booster = _train(self._train_params(), dataset,
+                             num_boost_round=self.conf.num_iterations)
+        self.booster = booster
+        if self.registry is not None:
+            try:
+                self.version = self.registry.current(self.name).version
+            except KeyError:
+                self.version = self._publish(booster)
+
+    # ---- internals ----
+    def _train_params(self) -> Dict:
+        """Params with iteration-count aliases stripped: engine.train honors
+        an explicit params entry over the num_boost_round keyword (the
+        was-set check), and the per-cycle round count is ours to pass."""
+        return {k: v for k, v in self.params.items()
+                if canonical_name(str(k)) != "num_iterations"}
+
+    def _publish(self, booster: Booster) -> int:
+        if self.server is not None:
+            return int(self.server.publish(booster, name=self.name))
+        if self.registry is not None:
+            return int(self.registry.publish(self.name, booster).version)
+        return self.version + 1
+
+    def _metric_value(self, X, y, w) -> float:
+        pred = self.booster.predict(
+            X, raw_score=not self._metric.use_prob)
+        return float(self._metric(np.asarray(y, dtype=np.float64), pred, w))
+
+    def _check_drift(self, X, y, w) -> Optional[str]:
+        if self._metric is None or self.conf.online_drift_metric_delta <= 0:
+            return None
+        cur = self._metric_value(X, y, w)
+        with self._lock:
+            base = self._baseline
+            if base is None:
+                self._baseline = cur
+                return None
+        worse = (base - cur) if self._metric.greater_is_better \
+            else (cur - base)
+        if worse > self.conf.online_drift_metric_delta:
+            obs.emit("drift_trigger", metric=self._metric.name,
+                     baseline=base, current=cur, delta=float(worse),
+                     rows=int(len(y)))
+            return "drift"
+        return None
+
+    # ---- the public loop surface ----
+    def feed(self, data, label, weight=None) -> Optional[int]:
+        """Buffer one batch; returns the new published version when this
+        batch triggered a refit cycle, else None."""
+        X = np.asarray(data, dtype=np.float64)
+        if X.ndim == 1:
+            X = X[None, :]
+        y = np.asarray(label, dtype=np.float64).reshape(-1)
+        if X.shape[0] != y.shape[0]:
+            log.fatal(f"feed: {X.shape[0]} rows but {y.shape[0]} labels")
+        w = None if weight is None else \
+            np.asarray(weight, dtype=np.float64).reshape(-1)
+        trigger = None
+        with self._lock:
+            self._pend_x.append(X)
+            self._pend_y.append(y)
+            if w is not None:
+                self._pend_w.append(w)
+            self.pending_rows += int(y.shape[0])
+            if self.pending_rows >= self.conf.online_refit_rows:
+                trigger = "rows"
+        if trigger is None:
+            trigger = self._check_drift(X, y, w)
+        if trigger is not None:
+            return self.refit_now(trigger=trigger)
+        return None
+
+    def flush(self) -> Optional[int]:
+        """Drain pending rows through one refit cycle now (end-of-stream)."""
+        return self.refit_now(trigger="flush")
+
+    def refit_now(self, trigger: str = "manual") -> Optional[int]:
+        """One full cycle: append pending rows, refit/continue the model,
+        publish. Returns the published version, or None if nothing pended."""
+        with self._lock:
+            if not self.pending_rows:
+                return None
+            t0 = time.time()
+            X = np.concatenate(self._pend_x, axis=0)
+            y = np.concatenate(self._pend_y)
+            w = np.concatenate(self._pend_w) if self._pend_w else None
+            n = self.pending_rows
+            self._pend_x, self._pend_y, self._pend_w = [], [], []
+            self.pending_rows = 0
+            self.dataset.append(X, label=y, weight=w)
+            mode = "boost" if self.conf.online_boost_rounds > 0 else "refit"
+            if mode == "boost":
+                from .engine import train as _train
+                delta = _train(self._train_params(), self.dataset,
+                               num_boost_round=self.conf.online_boost_rounds,
+                               init_model=self.booster)
+                new_bst = merge_boosters(self.booster, delta)
+            else:
+                new_bst = self.booster.refit(X, y, weight=w)
+            t_pub = time.time()
+            version = self._publish(new_bst)
+            publish_s = time.time() - t_pub
+            self.booster = new_bst
+            self.version = version
+            self.cycles += 1
+            # re-baseline on the refit model's own quality over the rows
+            # that closed this cycle: drift is measured against "how good
+            # was the model when it was last fit", not against history
+            if self._metric is not None and \
+                    self.conf.online_drift_metric_delta > 0:
+                self._baseline = self._metric_value(X, y, w)
+            duration_s = time.time() - t0
+            obs.emit("online_refit", trigger=trigger, rows=int(n),
+                     version=int(version), duration_s=duration_s, mode=mode,
+                     iteration=int(new_bst.current_iteration),
+                     publish_s=publish_s)
+        with _STATS_LOCK:
+            LAST_CYCLE_STATS.clear()
+            LAST_CYCLE_STATS.update({
+                "trigger": trigger, "mode": mode, "rows": int(n),
+                "total_rows": int(self.dataset.num_data),
+                "version": int(version), "duration_s": duration_s,
+                "publish_s": publish_s})
+        return version
+
+    def run(self, source, stop: Optional[threading.Event] = None,
+            poll_s: float = 0.05, flush_at_end: bool = True) -> int:
+        """Consume ``(X, y[, w])`` batches from ``source`` until it ends or
+        ``stop`` is set; returns the number of rows fed.
+
+        ``source`` is an iterable/generator of batches (``tail_source``), or
+        a zero-arg callable polled each step. ``None`` from either means
+        "nothing right now" — the loop waits ``poll_s`` on the stop event
+        (never a bare sleep: this loop is tpu-lint's scheduler-loop scope)
+        and polls again. A callable ends the loop by returning :data:`STOP`;
+        an iterable by exhausting."""
+        stop_ev = stop if stop is not None else threading.Event()
+        if callable(source) and not hasattr(source, "__iter__"):
+            src_fn = source
+        else:
+            it = iter(source)
+            def src_fn():
+                return next(it, STOP)
+        fed = 0
+        while not stop_ev.is_set():
+            batch = src_fn()
+            if batch is STOP:
+                break
+            if batch is None:
+                stop_ev.wait(poll_s)
+                continue
+            X, y = batch[0], batch[1]
+            w = batch[2] if len(batch) > 2 else None
+            self.feed(X, y, weight=w)
+            fed += int(np.asarray(y).reshape(-1).shape[0])
+        if flush_at_end and self.pending_rows:
+            self.flush()
+        return fed

@@ -500,8 +500,15 @@ class PredictServer:
             queue_max=conf.serve_queue_max,
             max_batch_rows=conf.serve_max_batch_rows,
             start=start)
+        self.online = None   # OnlineTrainer, via attach_online
         if model is not None:
             self.publish(model, name=name)
+
+    def attach_online(self, trainer) -> None:
+        """Attach an :class:`~.online.OnlineTrainer` so the ``!learn``
+        protocol command feeds it labeled rows; each refit cycle it triggers
+        publishes back into this server's registry (zero-downtime swap)."""
+        self.online = trainer
 
     def _warmup_sizes(self) -> Tuple[int, ...]:
         """1 + every power-of-two bucket up to serve_max_batch_rows, so the
@@ -545,6 +552,8 @@ class PredictServer:
 #
 #   <v1>,<v2>,...      feature row  ->  "<version>\t<val>[,<val>...]"
 #   !publish <path>    hot-swap     ->  "ok version=<n>"
+#   !learn <y>,<v1>,.. labeled row into the attached OnlineTrainer
+#                                   ->  "ok pending=<n>[ version=<v>]"
 #   !stats             stats        ->  one-line JSON
 #   !quit              shut down the server loop
 #
@@ -572,6 +581,25 @@ def handle_line(server: PredictServer, line: str,
             except Exception as e:
                 return f"error: publish failed: {e}"
             return f"ok version={v}"
+        if cmd[0] == "!learn":
+            # labeled row for the attached OnlineTrainer (label first, the
+            # label_index=0 file convention): "!learn <label>,<v1>,<v2>,..."
+            if server.online is None:
+                return "error: no online trainer attached"
+            if len(cmd) < 2:
+                return "error: !learn needs <label>,<v1>,<v2>,..."
+            try:
+                vals = [float(p)
+                        for p in cmd[1].replace(",", " ").split()]
+                if len(vals) < 2:
+                    raise ValueError("need a label and at least one feature")
+                ver = server.online.feed(
+                    np.asarray(vals[1:], dtype=np.float64)[None, :],
+                    [vals[0]])
+            except Exception as e:
+                return f"error: learn failed: {e}"
+            tail = f" version={ver}" if ver else ""
+            return f"ok pending={server.online.pending_rows}{tail}"
         return f"error: unknown command {cmd[0]}"
     try:
         parts = line.replace(",", " ").split()

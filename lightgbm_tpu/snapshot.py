@@ -226,3 +226,20 @@ def load_latest_valid(directory: str) -> Optional[SnapshotPayload]:
             log.warning(f"snapshot iteration {it} failed validation "
                         f"({type(e).__name__}: {e}); trying an older one")
     return None
+
+
+def booster_from_latest(directory: str):
+    """Newest valid snapshot as an init-model Booster, or None.
+
+    The continued-training entry point for grown datasets: ``set_resume_state``
+    refuses a dataset whose row count changed (its fingerprint pins num_data),
+    so continuing on an APPENDED Dataset goes through
+    ``train(init_model=booster_from_latest(dir), ...)`` — warm-starting the
+    scores from the snapshot's model text instead of restoring raw trainer
+    state. Returns ``(booster, iteration)`` or ``(None, 0)`` when the
+    directory holds no valid snapshot."""
+    payload = load_latest_valid(directory)
+    if payload is None:
+        return None, 0
+    from .basic import Booster
+    return Booster(model_file=payload.model_path), int(payload.iteration)

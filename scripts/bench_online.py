@@ -1,0 +1,211 @@
+"""Continuous-training bench: ONLINE_BENCH.json.
+
+Measures the three costs the online loop (online.py) exists to bound:
+
+- ``append``: append-ingest rows/s (re-bin against frozen boundaries +
+  stream through the chunked pipeline) vs the cold-construct rows/s of the
+  same total — the incremental path's win over rebuilding the dataset per
+  cycle, plus a bins-bit-identity check against a one-shot reference
+  construct.
+- ``cycles``: refit-to-publish latency per mode — a leaf-output ``refit``
+  cycle (shape-preserving; the serving hot path never recompiles) and a
+  continued-boosting ``boost`` cycle (``train(init_model=...)`` +
+  ``merge_boosters``) — split into append / model-update / publish time
+  from the trainer's own cycle stats.
+- ``hot_swap``: the served-QPS dip across a refit+publish under closed-loop
+  load — QPS in the windows before / during / after the swap, zero shed
+  and zero errors asserted from the scheduler's counters.
+
+Usage: python scripts/bench_online.py [--quick] [out.json]
+Env: LGBM_TPU_ONLINE_BENCH_ROWS / _ITERS / _SECONDS / _CLIENTS
+"""
+import json
+import os
+import sys
+import threading
+import time
+
+sys.path.insert(0, os.path.dirname(os.path.dirname(os.path.abspath(__file__))))
+
+TRAIN_ROWS = int(os.environ.get("LGBM_TPU_ONLINE_BENCH_ROWS", 200_000))
+TRAIN_ITERS = int(os.environ.get("LGBM_TPU_ONLINE_BENCH_ITERS", 20))
+SECONDS = float(os.environ.get("LGBM_TPU_ONLINE_BENCH_SECONDS", 1.5))
+CLIENTS = int(os.environ.get("LGBM_TPU_ONLINE_BENCH_CLIENTS", 8))
+
+
+def _percentiles(lat):
+    import numpy as np
+    a = np.asarray(sorted(lat))
+    return {
+        "p50_ms": round(float(np.percentile(a, 50)) * 1e3, 4),
+        "p99_ms": round(float(np.percentile(a, 99)) * 1e3, 4),
+        "max_ms": round(float(a[-1]) * 1e3, 4),
+    }
+
+
+def run(out_path=None, quick=False):
+    import numpy as np
+    import jax
+
+    import lightgbm_tpu as lgb
+    from lightgbm_tpu.online import OnlineTrainer, last_cycle_stats
+    from lightgbm_tpu.server import PredictServer
+
+    rows = min(TRAIN_ROWS, 20_000) if quick else TRAIN_ROWS
+    iters = min(TRAIN_ITERS, 5) if quick else TRAIN_ITERS
+    seconds = 0.4 if quick else SECONDS
+    half = rows // 2
+
+    from bench import synth_higgs
+    X, y = synth_higgs(rows)
+    params = {"objective": "binary", "num_leaves": 63, "max_bin": 63,
+              "learning_rate": 0.1, "verbose": -1, "prewarm": 0}
+
+    # ---- append-ingest vs cold construct ----
+    t0 = time.perf_counter()
+    ds = lgb.Dataset(X[:half], label=y[:half], params=params)
+    ds.construct()
+    construct_half_s = time.perf_counter() - t0
+    t0 = time.perf_counter()
+    ds.append(X[half:], label=y[half:])
+    append_s = time.perf_counter() - t0
+    t0 = time.perf_counter()
+    cold = lgb.Dataset(X, label=y, params=params)
+    cold.construct()
+    construct_full_s = time.perf_counter() - t0
+    ref = lgb.Dataset(X, label=y, params=params, reference=ds)
+    ref.construct()
+    bins_equal = bool(np.array_equal(np.asarray(ds.bins[:rows]),
+                                     np.asarray(ref.bins[:rows])))
+    append_rps = (rows - half) / append_s
+    cold_rps = rows / construct_full_s
+    append = {
+        "appended_rows": rows - half,
+        "append_s": round(append_s, 3),
+        "append_rows_per_s": round(append_rps, 1),
+        "cold_construct_s": round(construct_full_s, 3),
+        "cold_construct_rows_per_s": round(cold_rps, 1),
+        "construct_half_s": round(construct_half_s, 3),
+        "append_vs_cold_construct": round(append_rps / cold_rps, 2),
+        "bins_bit_identical_to_reference_construct": bins_equal,
+    }
+    print(f"# append {append_rps:,.0f} rows/s vs cold construct "
+          f"{cold_rps:,.0f} rows/s (bit-identical: {bins_equal})",
+          file=sys.stderr)
+
+    print(f"# training {half} rows x {iters} iters...", file=sys.stderr)
+    booster = lgb.train(params, lgb.Dataset(X[:half], label=y[:half],
+                                            params=params),
+                        num_boost_round=iters)
+    queries = X[:1024]
+
+    # ---- refit-to-publish latency per mode ----
+    cycles = {}
+    chunk = min(10_000, half // 2)
+    for mode, boost_rounds in (("refit", 0), ("boost", max(iters // 4, 1))):
+        mp = dict(params)
+        mp.update({"online_refit_rows": 10 ** 9,
+                   "online_boost_rounds": boost_rounds})
+        mds = lgb.Dataset(X[:half], label=y[:half], params=mp)
+        srv = PredictServer(mp, model=booster)
+        tr = OnlineTrainer(mp, mds, booster=booster, server=srv)
+        tr.feed(X[half:half + chunk], y[half:half + chunk])
+        t0 = time.perf_counter()
+        tr.flush()
+        cycle_s = time.perf_counter() - t0
+        st = last_cycle_stats()
+        cycles[mode] = {
+            "rows": chunk,
+            "cycle_s": round(cycle_s, 3),
+            "append_plus_update_s": round(st["duration_s"] - st["publish_s"],
+                                          3),
+            "publish_s": round(st["publish_s"], 3),
+            "version": st["version"],
+        }
+        print(f"# {mode} cycle on {chunk} rows: {cycle_s:.3f}s "
+              f"(publish {st['publish_s']:.3f}s)", file=sys.stderr)
+        srv.close()
+
+    # ---- served-QPS dip across a mid-load refit + hot swap ----
+    hp = dict(params)
+    hp.update({"online_refit_rows": 10 ** 9, "online_boost_rounds": 0})
+    hds = lgb.Dataset(X[:half], label=y[:half], params=hp)
+    srv = PredictServer(hp, model=booster)
+    tr = OnlineTrainer(hp, hds, booster=booster, server=srv)
+    lat, errs = [], []
+    lat_lock = threading.Lock()
+    stop = threading.Event()
+
+    def client(t):
+        my = []
+        try:
+            i = t
+            while not stop.is_set():
+                q0 = time.perf_counter()
+                srv.predict(queries[i % len(queries)], timeout=60)
+                my.append((q0, time.perf_counter() - q0))
+                i += CLIENTS
+        except Exception as e:                   # pragma: no cover
+            errs.append(repr(e))
+        with lat_lock:
+            lat.extend(my)
+
+    ths = [threading.Thread(target=client, args=(t,)) for t in range(CLIENTS)]
+    [t.start() for t in ths]
+    time.sleep(seconds)                          # steady state on v1
+    tr.feed(X[half:half + chunk], y[half:half + chunk])
+    s0 = time.perf_counter()
+    tr.flush()                                   # refit + publish under load
+    swap_s = time.perf_counter() - s0
+    time.sleep(seconds)                          # steady state on v2
+    stop.set()
+    [t.join() for t in ths]
+
+    def _qps(lo, hi):
+        n = sum(1 for q0, _ in lat if lo <= q0 < hi)
+        return round(n / (hi - lo), 1) if hi > lo else 0.0
+
+    before = _qps(s0 - seconds, s0)
+    during = _qps(s0, s0 + swap_s)
+    after = _qps(s0 + swap_s, s0 + swap_s + seconds)
+    st = srv.batcher.snapshot()
+    hot_swap = {
+        "clients": CLIENTS,
+        "requests": len(lat),
+        "swap_cycle_s": round(swap_s, 3),
+        "qps_before": before,
+        "qps_during_swap": during,
+        "qps_after": after,
+        "dip_pct": round(100.0 * (1.0 - during / before), 1) if before else 0.0,
+        "shed": st["shed"],
+        "errors": errs[:3],
+        "zero_drops": st["shed"] == 0 and not errs,
+        **_percentiles([d for _, d in lat]),
+    }
+    print(f"# hot swap: {before:,.0f} -> {during:,.0f} -> {after:,.0f} qps "
+          f"(cycle {swap_s:.3f}s, shed {st['shed']})", file=sys.stderr)
+    srv.close()
+
+    result = {
+        "bench": "online_continuous_training",
+        "backend": jax.default_backend(),
+        "devices": jax.device_count(),
+        "cores": os.cpu_count() or 1,
+        "quick": bool(quick),
+        "model": {"rows": rows, "iters": iters, "num_leaves": 63,
+                  "max_bin": 63, "features": int(X.shape[1])},
+        "append": append,
+        "cycles": cycles,
+        "hot_swap": hot_swap,
+    }
+    doc = json.dumps(result, indent=2)
+    if out_path:
+        from lightgbm_tpu.utils.atomic_io import atomic_write_text
+        atomic_write_text(out_path, doc + "\n")
+    print(doc)
+    return result
+
+
+if __name__ == "__main__":
+    argv = [a for a in sys.argv[1:] if a != "--quick"]
+    run(argv[0] if argv else None, quick=len(argv) < len(sys.argv) - 1)

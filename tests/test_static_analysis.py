@@ -774,3 +774,99 @@ def test_server_module_in_shared_state_scope():
         analyze_source(SERVER_SHARED_BAD, relpath=SERVER_REL))
     assert "unlocked-shared-state" not in names(
         analyze_source(SERVER_SHARED_LOCKED, relpath=SERVER_REL))
+
+
+# ---- online-trainer rule scopes (PR: continuous training) ----
+# online.py's run() loop drains a shared batch source the same way the
+# microbatch scheduler drains its queue — one loop, many buffered batches
+# behind it — so it joins both the scheduler-loop audit (no sleep, no bare
+# join/get) and the shared-state scope (the module-level cycle stats).
+
+ONLINE_REL = "lightgbm_tpu/online.py"
+
+ONLINE_RUN_BAD = """
+import time
+
+def run(self, source, stop):
+    while not stop.is_set():
+        batch = self._q.get()
+        time.sleep(0.05)
+        self._worker.join()
+        self.feed(*batch)
+"""
+
+ONLINE_RUN_SUPPRESSED = """
+import time
+
+def run(self, source, stop):
+    while not stop.is_set():
+        batch = source()
+        if batch is None:
+            # offline replay harness: pacing the feed IS the simulation
+            time.sleep(0.05)   # tpu-lint: disable=host-sync-in-jit
+            continue
+        self.feed(*batch)
+"""
+
+ONLINE_RUN_CLEAN = """
+def run(self, source, stop):
+    while not stop.is_set():
+        batch = source()
+        if batch is None:
+            stop.wait(0.05)
+            continue
+        self.feed(*batch)
+"""
+
+
+def test_online_run_loop_blocking_calls_fire():
+    found = analyze_source(ONLINE_RUN_BAD, relpath=ONLINE_REL)
+    assert "host-sync-in-jit" in names(found)
+    msgs = [f.message for f in found if f.rule == "host-sync-in-jit"]
+    assert any("sleep" in m for m in msgs), msgs
+    assert any(".join()" in m for m in msgs), msgs
+    assert any(".get()" in m for m in msgs), msgs
+    # run() elsewhere is not a designated scheduler loop
+    assert "host-sync-in-jit" not in names(
+        analyze_source(ONLINE_RUN_BAD, relpath="lightgbm_tpu/basic.py"))
+
+
+def test_online_run_loop_suppressed_and_clean():
+    assert "host-sync-in-jit" not in names(
+        analyze_source(ONLINE_RUN_SUPPRESSED, relpath=ONLINE_REL))
+    kept = analyze_source(ONLINE_RUN_SUPPRESSED, relpath=ONLINE_REL,
+                          keep_suppressed=True)
+    assert "host-sync-in-jit" in names(kept)
+    # the shipped idiom — wait on the stop event, bounded — is clean
+    assert "host-sync-in-jit" not in names(
+        analyze_source(ONLINE_RUN_CLEAN, relpath=ONLINE_REL))
+
+
+ONLINE_STATS_BAD = """
+LAST_CYCLE_STATS = {}
+
+def record(stats):
+    LAST_CYCLE_STATS.clear()
+    LAST_CYCLE_STATS.update(stats)
+"""
+
+ONLINE_STATS_LOCKED = """
+import threading
+_STATS_LOCK = threading.Lock()
+LAST_CYCLE_STATS = {}
+
+def record(stats):
+    with _STATS_LOCK:
+        LAST_CYCLE_STATS.clear()
+        LAST_CYCLE_STATS.update(stats)
+"""
+
+
+def test_online_module_in_shared_state_scope():
+    found = analyze_source(ONLINE_STATS_BAD, relpath=ONLINE_REL)
+    assert names(found).count("unlocked-shared-state") == 2   # clear + update
+    assert "unlocked-shared-state" not in names(
+        analyze_source(ONLINE_STATS_LOCKED, relpath=ONLINE_REL))
+    # outside the threaded scope the same mutation is the normal idiom
+    assert "unlocked-shared-state" not in names(
+        analyze_source(ONLINE_STATS_BAD, relpath="lightgbm_tpu/basic.py"))
