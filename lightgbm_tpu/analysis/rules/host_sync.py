@@ -63,6 +63,10 @@ SCHED_LOOPS: Set[Tuple[str, str]] = {
     # the online feed loop drains a shared source the same way: a bare
     # sleep / un-timed get there stalls every buffered batch behind it
     ("lightgbm_tpu/online.py", "run"),
+    # the periodic metrics flusher must wait on its stop event (bounded,
+    # interruptible), never a bare sleep — a sleep there delays shutdown
+    # by up to a full flush interval
+    ("lightgbm_tpu/obs/__init__.py", "_flush_loop"),
 }
 
 

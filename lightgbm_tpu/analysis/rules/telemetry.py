@@ -17,9 +17,12 @@ rarely-hit paths like fault injection and distributed retries. The schema
 registry is extracted by AST-parsing ``obs/events.py``, never by importing
 it, so the rule runs JAX-free.
 
-The ``obs/`` package itself is out of scope (it holds the emit/validate
-plumbing — delegating wrappers with a non-literal etype — not telemetry call
-sites), as are ``scripts/`` and the analysis package.
+The ``obs/`` PLUMBING modules are out of scope (events.py, __init__.py,
+metrics.py, tracing.py, memory.py hold the emit/validate machinery —
+delegating wrappers with a non-literal etype — not telemetry call sites), as
+are ``scripts/`` and the analysis package.  The obs modules that EMIT real
+events (slo.py, flight.py, http_server.py) are in scope like any product
+module: their literal emit sites must match EVENT_SCHEMAS.
 """
 from __future__ import annotations
 
@@ -27,7 +30,12 @@ import ast
 
 from ..core import ModuleContext, Rule, event_schemas, register
 
-_SKIP_PREFIXES = ("lightgbm_tpu/obs/", "lightgbm_tpu/analysis/", "scripts/")
+_SKIP_PREFIXES = ("lightgbm_tpu/obs/events.py",
+                  "lightgbm_tpu/obs/__init__.py",
+                  "lightgbm_tpu/obs/metrics.py",
+                  "lightgbm_tpu/obs/tracing.py",
+                  "lightgbm_tpu/obs/memory.py",
+                  "lightgbm_tpu/analysis/", "scripts/")
 
 
 @register

@@ -243,6 +243,7 @@ def run_serve(conf: Config, params: Dict) -> None:
              f"(window={conf.serve_batch_window_us}us, "
              f"queue_max={conf.serve_queue_max}, "
              f"max_batch_rows={conf.serve_max_batch_rows})")
+    flush_owner = obs.start_periodic_flush(conf.metrics_flush_secs)
     try:
         if conf.serve_port > 0:
             serve_tcp(server, "0.0.0.0", conf.serve_port)
@@ -250,6 +251,7 @@ def run_serve(conf: Config, params: Dict) -> None:
             served = serve_stdio(server, sys.stdin, sys.stdout)
             log.info(f"Finished serving; {served} lines handled")
     finally:
+        obs.stop_periodic_flush(flush_owner)
         server.close()
         exported = obs.export_all(conf.metrics_out)
         if exported:
@@ -291,6 +293,7 @@ def run_online(conf: Config, params: Dict) -> None:
         threading.Thread(target=serve_tcp,
                          args=(server, "0.0.0.0", conf.serve_port),
                          daemon=True).start()
+    flush_owner = obs.start_periodic_flush(conf.metrics_flush_secs)
     try:
         fed = trainer.run(tail_source(conf.online_feed, stop=stop,
                                       follow=follow), stop=stop)
@@ -301,6 +304,7 @@ def run_online(conf: Config, params: Dict) -> None:
         log.info("online: interrupted; flushing pending rows")
         trainer.flush()
     finally:
+        obs.stop_periodic_flush(flush_owner)
         server.close()
         trainer.booster.save_model(conf.output_model)
         log.info(f"Finished online training; model saved to "

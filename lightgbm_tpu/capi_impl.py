@@ -221,7 +221,9 @@ def server_publish(server, model_path: str) -> int:
 
 def server_stats_json(server) -> str:
     """One-line JSON: scheduler counters (requests/flushes/shed/coalesce
-    factor/queue depth) + per-model registry state."""
+    factor/queue depth), per-model registry state incl. ``age_s`` freshness,
+    and — when configured — SLO attainment/burn-rate plus p50/p95/p99
+    request-latency summaries."""
     import json
     return json.dumps(server.stats(), sort_keys=True)
 

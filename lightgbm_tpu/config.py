@@ -249,6 +249,32 @@ _PARAMS: Dict[str, Tuple[Any, Tuple[str, ...]]] = {
     # start an on-demand XLA profiler capture into this directory for the
     # duration of training (heavy; leave empty in production)
     "xla_trace_out": ("", ("xla_trace_dir",)),
+    # ---- live observability plane (obs/http_server.py, obs/slo.py,
+    # obs/flight.py, obs/tracing.py; see docs/OBSERVABILITY.md) ----
+    # in-process HTTP endpoint on 127.0.0.1 serving /metrics (live
+    # Prometheus scrape), /healthz and /statusz (0 = off)
+    "obs_port": (0, ()),
+    # per-request latency SLO for the serve path, in milliseconds
+    # (0 = SLO tracking off)
+    "serve_slo_ms": (0.0, ()),
+    # SLO attainment target over the rolling window, in (0, 1)
+    "serve_slo_target": (0.99, ()),
+    # rolling attainment window, in requests
+    "serve_slo_window": (1024, ()),
+    # per-request span breakdown (queue_wait / bin / device_dispatch /
+    # readback) on the serve path; host-side clock reads only — zero new
+    # jit boundaries, predictions bit-exact
+    "serve_trace": (False, ()),
+    # keep 1-in-N complete request traces as exemplars (serve_trace on)
+    "serve_trace_sample": (16, ()),
+    # re-export metrics.json/metrics.prom every this many seconds during
+    # train/serve/online runs, atomically (0 = end-of-run export only)
+    "metrics_flush_secs": (0.0, ()),
+    # flight-recorder dump directory; empty falls back to metrics_out
+    # (no directory at all = recorder armed but dumps are dropped)
+    "flight_dir": ("", ()),
+    # flight-recorder ring capacity, in records (0 = recorder off)
+    "flight_events": (512, ()),
 }
 
 _LIST_FLOAT = {"feature_contri", "cegb_penalty_feature_lazy", "cegb_penalty_feature_coupled", "label_gain", "auc_mu_weights"}
@@ -393,6 +419,21 @@ class Config:
                       "trigger only)")
         if self.online_boost_rounds < 0:
             log.fatal("online_boost_rounds must be >= 0 (0 = leaf refit only)")
+        if not 0 <= self.obs_port <= 65535:
+            log.fatal(f"obs_port must be in [0, 65535], got {self.obs_port}")
+        if self.serve_slo_ms < 0:
+            log.fatal("serve_slo_ms must be >= 0 (0 = SLO tracking off)")
+        if not 0.0 < self.serve_slo_target < 1.0:
+            log.fatal(f"serve_slo_target must be in (0, 1), "
+                      f"got {self.serve_slo_target}")
+        if self.serve_slo_window < 1:
+            log.fatal("serve_slo_window must be >= 1")
+        if self.serve_trace_sample < 1:
+            log.fatal("serve_trace_sample must be >= 1 (1 = keep every trace)")
+        if self.metrics_flush_secs < 0:
+            log.fatal("metrics_flush_secs must be >= 0 (0 = end-of-run only)")
+        if self.flight_events < 0:
+            log.fatal("flight_events must be >= 0 (0 = flight recorder off)")
 
     def to_dict(self) -> Dict[str, Any]:
         out = {name: getattr(self, name) for name in _PARAMS}

@@ -172,6 +172,10 @@ def train(params: Dict[str, Any], train_set: Dataset,
     finished = False
     tele = obs.enabled()
     tracing.maybe_start_xla_trace(conf.xla_trace_out)
+    # metrics_flush_secs > 0: live re-export during the boosting loop so a
+    # scrape of metrics.prom mid-run sees fresh values; ownership token keeps
+    # a nested train (an online refit cycle) from stopping the outer flusher
+    flush_owner = obs.start_periodic_flush(conf.metrics_flush_secs)
     t_start = time.perf_counter()
     t_iter0 = t_start
     try:
@@ -262,6 +266,7 @@ def train(params: Dict[str, Any], train_set: Dataset,
     finally:
         # the capture brackets the boosting loop and survives fatal exits
         tracing.stop_xla_trace()
+        obs.stop_periodic_flush(flush_owner)
     # drop trailing phantom stumps queued by the lagged finished-check
     # (reference stops without adding them, gbdt.cpp:430)
     booster._gbdt.finish_training()

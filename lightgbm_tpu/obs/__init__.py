@@ -26,7 +26,7 @@ import threading
 from typing import Any, Dict, List, Optional
 
 from ..utils import log
-from . import memory, tracing
+from . import flight, memory, slo, tracing
 from .events import EVENT_SCHEMAS, EventLog, register_event
 from .metrics import Counter, Gauge, Histogram, MetricsRegistry
 from .tracing import maybe_start_xla_trace, span, stop_xla_trace
@@ -74,6 +74,13 @@ def configure_from_config(conf) -> None:
     env = _env_enabled()
     on = bool(getattr(conf, "telemetry", False)) if env is None else env
     configure(enabled=on, metrics_out=getattr(conf, "metrics_out", ""))
+    slo.TRACKER.configure(slo_ms=getattr(conf, "serve_slo_ms", None),
+                          target=getattr(conf, "serve_slo_target", None),
+                          window=getattr(conf, "serve_slo_window", None))
+    flight_dir = (getattr(conf, "flight_dir", "")
+                  or getattr(conf, "metrics_out", ""))
+    flight.FLIGHT.configure(out_dir=flight_dir,
+                            capacity=getattr(conf, "flight_events", None))
 
 
 def emit(etype: str, **fields: Any) -> None:
@@ -84,12 +91,61 @@ def emit(etype: str, **fields: Any) -> None:
     if not _STATE.enabled:
         return
     EVENTS.emit(etype, **fields)
+    if flight.FLIGHT.active:
+        flight.FLIGHT.note_event(etype, fields)
 
 
 def reset() -> None:
-    """Clear accumulated events and metrics (per-run isolation in tests)."""
-    EVENTS.clear()
-    METRICS.clear()
+    """Clear accumulated events, metrics, SLO windows, trace exemplars and
+    flight-recorder state (per-run isolation in tests) under one lock, so a
+    concurrent configure can't observe a half-reset plane."""
+    with _STATE.lock:
+        EVENTS.clear()
+        METRICS.clear()
+        slo.TRACKER.reset()
+        tracing.TRACES.clear()
+        flight.FLIGHT.reset()
+
+
+# ---- derived-gauge collectors ----------------------------------------------
+# Run just before a scrape (/metrics) or an export so point-in-time gauges
+# (event drops, buffered counts per family, device memory, model age) are
+# fresh; nothing here runs on the hot paths.
+
+_collectors_lock = threading.Lock()
+_COLLECTORS: Dict[str, Any] = {}
+
+
+def add_collector(name: str, fn) -> None:
+    """Register ``fn(METRICS)`` to run before scrapes/exports (latest wins)."""
+    with _collectors_lock:
+        _COLLECTORS[name] = fn
+
+
+def remove_collector(name: str) -> None:
+    with _collectors_lock:
+        _COLLECTORS.pop(name, None)
+
+
+def run_collectors() -> None:
+    with _collectors_lock:
+        fns = list(_COLLECTORS.items())
+    for name, fn in fns:
+        try:
+            fn(METRICS)
+        except Exception as e:  # a broken collector must not break a scrape
+            log.warning(f"metrics collector {name!r} failed "
+                        f"({type(e).__name__}: {e})")
+
+
+def _events_collector(reg: MetricsRegistry) -> None:
+    reg.gauge("events_buffered",
+              "telemetry events currently buffered").set(len(EVENTS))
+    reg.gauge("events_dropped",
+              "telemetry events dropped from the bounded log").set(EVENTS.dropped)
+    for etype, n in EVENTS.family_counts().items():
+        reg.gauge("events_by_type", "buffered telemetry events by type",
+                  type=etype).set(n)
 
 
 def export_all(out_dir: Optional[str] = None) -> Optional[str]:
@@ -100,7 +156,7 @@ def export_all(out_dir: Optional[str] = None) -> Optional[str]:
     if not out_dir or not _STATE.enabled:
         return None
     try:
-        memory.update_gauges(METRICS)
+        run_collectors()
         EVENTS.write_jsonl(os.path.join(out_dir, "events.jsonl"))
         METRICS.write_json(os.path.join(out_dir, "metrics.json"))
         METRICS.write_prometheus(os.path.join(out_dir, "metrics.prom"))
@@ -111,8 +167,63 @@ def export_all(out_dir: Optional[str] = None) -> Optional[str]:
     return out_dir
 
 
+# ---- periodic metrics flush -------------------------------------------------
+
+_flush_lock = threading.Lock()
+_flush_thread: Optional[threading.Thread] = None
+_flush_stop: Optional[threading.Event] = None
+
+
+def _flush_loop(interval_s: float, stop: "threading.Event") -> None:
+    while not stop.wait(interval_s):
+        export_all()
+
+
+def start_periodic_flush(interval_s: float) -> bool:
+    """Start the background re-export loop (``metrics_flush_secs`` knob).
+    Returns True only to the caller that now owns it — pass that back to
+    :func:`stop_periodic_flush` so a nested ``engine.train`` (an online refit
+    cycle) can't tear down the outer run's flusher."""
+    global _flush_thread, _flush_stop
+    if interval_s is None or interval_s <= 0:
+        return False
+    if not _STATE.enabled or not _STATE.metrics_out:
+        return False
+    with _flush_lock:
+        if _flush_thread is not None and _flush_thread.is_alive():
+            return False
+        stop = threading.Event()
+        th = threading.Thread(target=_flush_loop, args=(float(interval_s), stop),
+                              name="lgbm-obs-flush", daemon=True)
+        _flush_stop = stop
+        _flush_thread = th
+        th.start()
+    return True
+
+
+def stop_periodic_flush(owned: bool) -> None:
+    """Stop the flusher if ``owned`` (the start_periodic_flush return)."""
+    global _flush_thread, _flush_stop
+    if not owned:
+        return
+    with _flush_lock:
+        th, stop = _flush_thread, _flush_stop
+        _flush_thread = None
+        _flush_stop = None
+    if stop is not None:
+        stop.set()
+    if th is not None and th.is_alive():
+        th.join(timeout=5.0)
+
+
+add_collector("events", _events_collector)
+add_collector("memory", memory.update_gauges)
+
+
 __all__ = ["EVENTS", "METRICS", "EVENT_SCHEMAS", "EventLog", "MetricsRegistry",
            "Counter", "Gauge", "Histogram", "register_event",
            "configure", "configure_from_config", "enabled", "emit", "reset",
            "export_all", "span", "maybe_start_xla_trace", "stop_xla_trace",
-           "memory", "tracing"]
+           "memory", "tracing", "slo", "flight",
+           "add_collector", "remove_collector", "run_collectors",
+           "start_periodic_flush", "stop_periodic_flush"]

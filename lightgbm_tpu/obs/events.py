@@ -125,6 +125,17 @@ EVENT_SCHEMAS: Dict[str, Tuple[Dict[str, Any], Dict[str, Any]]] = {
     "drift_trigger": ({"metric": str, "baseline": _NUM, "current": _NUM,
                        "delta": _NUM},
                       {"rows": int}),
+    # rolling SLO attainment crossed the target (obs/slo.py): emitted on
+    # both transitions — recovered=True marks the climb back above target
+    "slo_breach": ({"model": str, "attainment": _NUM, "target": _NUM},
+                   {"burn_rate": _NUM, "recovered": bool, "window": int}),
+    # the flight-recorder ring was dumped to disk (obs/flight.py): reason is
+    # a TRIP_EVENTS type, "unhandled_exception", "sigterm", or an explicit
+    # caller string; events/spans count the record kinds in the dump
+    "flight_dump": ({"reason": str, "events": int},
+                    {"spans": int, "path": str, "error": str}),
+    # ObsServer HTTP endpoint lifecycle (obs/http_server.py)
+    "obs_server": ({"phase": str}, {"port": int, "error": str}),
 }
 
 
@@ -174,6 +185,7 @@ class EventLog:
     def __init__(self, capacity: int = 65536) -> None:
         self._events: collections.deque = collections.deque(maxlen=capacity)
         self._lock = threading.Lock()
+        self._family: Dict[str, int] = {}
         self.dropped = 0
 
     def emit(self, etype: str, **fields: Any) -> None:
@@ -182,8 +194,11 @@ class EventLog:
         rec.update(fields)
         with self._lock:
             if len(self._events) == self._events.maxlen:
+                oldest = self._events[0]
+                self._family[oldest["type"]] -= 1
                 self.dropped += 1
             self._events.append(rec)
+            self._family[etype] = self._family.get(etype, 0) + 1
 
     def __len__(self) -> int:
         with self._lock:
@@ -193,9 +208,15 @@ class EventLog:
         with self._lock:
             return list(self._events)
 
+    def family_counts(self) -> Dict[str, int]:
+        """Buffered events per type (post-drop, so sums to ``len(self)``)."""
+        with self._lock:
+            return {k: v for k, v in self._family.items() if v > 0}
+
     def clear(self) -> None:
         with self._lock:
             self._events.clear()
+            self._family.clear()
             self.dropped = 0
 
     def to_jsonl(self) -> str:

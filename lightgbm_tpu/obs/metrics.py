@@ -168,6 +168,15 @@ class MetricsRegistry:
         with self._lock:
             self._families.clear()
 
+    def get_family(self, name: str) -> Optional[Tuple[str, Dict[LabelKey, Any]]]:
+        """``(kind, {label_key: child})`` snapshot of one family, or None.
+        The child objects are live (their own locks guard reads)."""
+        with self._lock:
+            fam = self._families.get(name)
+            if fam is None:
+                return None
+            return fam.kind, dict(fam.children)
+
     # ---- exporters ----
     def to_json(self) -> Dict[str, Any]:
         out: Dict[str, Any] = {}
@@ -223,6 +232,35 @@ class MetricsRegistry:
 
     def write_prometheus(self, path: str, prefix: str = "lgbmtpu_") -> None:
         atomic_io.atomic_write_text(path, self.to_prometheus(prefix=prefix))
+
+
+def histogram_quantiles(snap: Dict[str, Any],
+                        qs: Tuple[float, ...] = (0.5, 0.95, 0.99),
+                        ) -> Dict[float, float]:
+    """Estimate quantiles from a :meth:`Histogram.snapshot` by linear
+    interpolation within the covering bucket — the same estimate Prometheus'
+    ``histogram_quantile`` gives.  Observations in the +Inf bucket clamp to
+    the last finite bound; an empty histogram yields 0.0 for every q."""
+    bounds, counts = snap["bounds"], snap["counts"]
+    total = snap["count"]
+    out: Dict[float, float] = {}
+    for q in qs:
+        if total <= 0:
+            out[q] = 0.0
+            continue
+        rank = q * total
+        cum = 0
+        val = bounds[-1]
+        for i, cnt in enumerate(counts):
+            cum += cnt
+            if cum >= rank:
+                if i < len(bounds):
+                    lo = bounds[i - 1] if i > 0 else 0.0
+                    frac = (rank - (cum - cnt)) / cnt if cnt else 1.0
+                    val = lo + (bounds[i] - lo) * frac
+                break
+        out[q] = val
+    return out
 
 
 def _fmt_float(v: float) -> str:

@@ -1,0 +1,125 @@
+"""Per-model latency SLOs: rolling attainment windows + error-budget burn.
+
+The serve path (server.MicroBatcher._flush_group) feeds one ``observe`` per
+completed request; the tracker keeps a bounded window of in/out-of-SLO
+booleans per model and publishes the derived gauges into ``obs.METRICS`` so
+they show up both on the live ``/metrics`` scrape and in ``export_all``:
+
+    slo_attainment{model=}    fraction of windowed requests within the SLO
+    slo_burn_rate{model=}     (1 - attainment) / (1 - target); >1 means the
+                              error budget is burning faster than allotted
+    slo_requests_total{model=} / slo_violations_total{model=}
+
+Inactive (the default, ``serve_slo_ms=0``) the tracker costs one lock-guarded
+comparison per request and records nothing.  Attainment transitions across
+the target emit a ``slo_breach`` event in both directions (breach/recovery).
+"""
+from __future__ import annotations
+
+import collections
+import threading
+from typing import Any, Dict, Optional
+
+_DEF_TARGET = 0.99
+_DEF_WINDOW = 1024
+
+
+class SLOTracker:
+    """Thread-safe rolling-window SLO attainment tracker (one per process)."""
+
+    def __init__(self) -> None:
+        self._lock = threading.Lock()
+        self._slo_s = 0.0
+        self._target = _DEF_TARGET
+        self._window = _DEF_WINDOW
+        self._models: Dict[str, Dict[str, Any]] = {}
+
+    def configure(self, slo_ms: Optional[float] = None,
+                  target: Optional[float] = None,
+                  window: Optional[int] = None) -> None:
+        """Apply the serve_slo_* knobs; a window-size change drops history
+        (the old samples would misweight the new window)."""
+        with self._lock:
+            if slo_ms is not None:
+                self._slo_s = float(slo_ms) / 1e3
+            if target is not None:
+                self._target = float(target)
+            if window is not None:
+                w = max(1, int(window))
+                if w != self._window:
+                    self._window = w
+                    self._models.clear()
+
+    @property
+    def active(self) -> bool:
+        with self._lock:
+            return self._slo_s > 0.0
+
+    def observe(self, model: str, latency_s: float) -> None:
+        """Record one completed request's end-to-end latency."""
+        from . import METRICS, emit
+        with self._lock:
+            if self._slo_s <= 0.0:
+                return
+            st = self._models.get(model)
+            if st is None:
+                st = {"window": collections.deque(maxlen=self._window),
+                      "requests": 0, "violations": 0, "breached": False}
+                self._models[model] = st
+            ok = float(latency_s) <= self._slo_s
+            st["window"].append(ok)
+            st["requests"] += 1
+            if not ok:
+                st["violations"] += 1
+            att = sum(st["window"]) / len(st["window"])
+            target = self._target
+            burn = (1.0 - att) / max(1e-12, 1.0 - target)
+            breached = att < target
+            flipped = breached != st["breached"]
+            st["breached"] = breached
+        METRICS.gauge("slo_attainment",
+                      "fraction of windowed requests within the latency SLO",
+                      model=model).set(att)
+        METRICS.gauge("slo_burn_rate",
+                      "error-budget burn rate: (1-attainment)/(1-target)",
+                      model=model).set(burn)
+        METRICS.counter("slo_requests", "requests observed by the SLO tracker",
+                        model=model).inc()
+        if not ok:
+            METRICS.counter("slo_violations", "requests over the latency SLO",
+                            model=model).inc()
+        if flipped:
+            emit("slo_breach", model=model, attainment=att, target=target,
+                 burn_rate=burn, recovered=not breached)
+
+    def snapshot(self) -> Dict[str, Dict[str, Any]]:
+        """Per-model SLO state for ``!stats`` / ``/statusz`` ({} when off)."""
+        with self._lock:
+            if self._slo_s <= 0.0:
+                return {}
+            out: Dict[str, Dict[str, Any]] = {}
+            for model, st in self._models.items():
+                win = st["window"]
+                att = (sum(win) / len(win)) if win else 1.0
+                out[model] = {
+                    "slo_ms": self._slo_s * 1e3,
+                    "target": self._target,
+                    "window": len(win),
+                    "attainment": att,
+                    "burn_rate": (1.0 - att) / max(1e-12, 1.0 - self._target),
+                    "requests": st["requests"],
+                    "violations": st["violations"],
+                    "breached": st["breached"],
+                }
+            return out
+
+    def reset(self) -> None:
+        """Back to the unconfigured default (per-run isolation in tests)."""
+        with self._lock:
+            self._models.clear()
+            self._slo_s = 0.0
+            self._target = _DEF_TARGET
+            self._window = _DEF_WINDOW
+
+
+TRACKER = SLOTracker()

@@ -7,6 +7,13 @@ enabled — a log2 latency histogram ``span_seconds{span=<name>}`` in the
 metrics registry.  Code that already sits inside a ``TIMER.scope`` keeps
 working unchanged; new call sites should prefer ``span``.
 
+Request tracing (serve path): :func:`mint_trace_id` stamps a process-unique
+id on each request at serve ingress; the MicroBatcher flush records the span
+breakdown (queue_wait / bin / device_dispatch / readback) through
+:func:`record_span` into the same ``span_seconds`` histogram family, and
+keeps 1-in-N complete traces as exemplars in :data:`TRACES` — all host-side
+clock reads, zero new jit boundaries.
+
 :func:`maybe_start_xla_trace` / :func:`stop_xla_trace` drive an on-demand XLA
 profiler capture (``jax.profiler.start_trace``) gated by the ``xla_trace_out``
 config knob — a full device trace is far too heavy to leave on, so it only
@@ -14,10 +21,12 @@ runs when an operator names an output directory.
 """
 from __future__ import annotations
 
+import collections
 import contextlib
+import itertools
 import threading
 import time
-from typing import Optional
+from typing import Any, Dict, List, Optional
 
 from ..utils import log
 from ..utils.timer import TIMER
@@ -41,6 +50,59 @@ def span(name: str, block_on=None):
     if enabled():
         METRICS.histogram("span_seconds", "span wall time by name",
                           span=name).observe(time.perf_counter() - t0)
+
+
+def record_span(name: str, seconds: float) -> None:
+    """Observe an externally-timed duration into ``span_seconds{span=name}``
+    (the flush path measures with bare perf_counter reads instead of nesting
+    ``span`` contextmanagers per request)."""
+    from . import METRICS, enabled
+    if enabled():
+        METRICS.histogram("span_seconds", "span wall time by name",
+                          span=name).observe(seconds)
+
+
+class TraceBuffer:
+    """Bounded ring of sampled request-trace exemplars (thread-safe)."""
+
+    def __init__(self, capacity: int = 256) -> None:
+        self._lock = threading.Lock()
+        self._ring: collections.deque = collections.deque(maxlen=capacity)
+        self._ids = itertools.count(1)
+        self._sampled = 0
+
+    def mint_trace_id(self) -> str:
+        return f"req-{next(self._ids):08x}"  # itertools.count is atomic
+
+    def maybe_record(self, trace: Dict[str, Any], sample: int = 1) -> bool:
+        """Keep this trace as an exemplar with 1-in-``sample`` probability
+        (deterministic round-robin, so sample=1 keeps everything)."""
+        with self._lock:
+            self._sampled += 1
+            if sample > 1 and (self._sampled % sample) != 1:
+                return False
+            self._ring.append(dict(trace))
+            return True
+
+    def record(self, trace: Dict[str, Any]) -> None:
+        with self._lock:
+            self._ring.append(dict(trace))
+
+    def snapshot(self) -> List[Dict[str, Any]]:
+        with self._lock:
+            return list(self._ring)
+
+    def clear(self) -> None:
+        with self._lock:
+            self._ring.clear()
+            self._sampled = 0
+
+
+TRACES = TraceBuffer()
+
+
+def mint_trace_id() -> str:
+    return TRACES.mint_trace_id()
 
 
 def maybe_start_xla_trace(out_dir: str) -> bool:

@@ -301,6 +301,21 @@ class OnlineTrainer:
                 "publish_s": publish_s})
         return version
 
+    def statusz(self) -> Dict[str, Any]:
+        """Live trainer state for the ObsServer /statusz endpoint."""
+        with self._lock:
+            out = {"pending_rows": int(self.pending_rows),
+                   "cycles": int(self.cycles),
+                   "version": int(self.version),
+                   "total_rows": int(self.dataset.num_data),
+                   "mode": ("boost" if self.conf.online_boost_rounds > 0
+                            else "refit"),
+                   "drift_baseline": self._baseline}
+        last = last_cycle_stats()
+        if last:
+            out["last_cycle"] = last
+        return out
+
     def run(self, source, stop: Optional[threading.Event] = None,
             poll_s: float = 0.05, flush_at_end: bool = True) -> int:
         """Consume ``(X, y[, w])`` batches from ``source`` until it ends or

@@ -53,8 +53,11 @@ import numpy as np
 
 from . import obs
 from .config import Config, params_to_config
+from .obs import flight, slo, tracing
+from .obs import http_server as obs_http
+from .obs.metrics import histogram_quantiles
 from .serving import PredictEngine, bucket_rows
-from .utils import log
+from .utils import faults, log
 from .utils.log import LightGBMError
 
 # scheduler idle poll: the ONLY place the scheduler blocks is the staging
@@ -69,9 +72,14 @@ class ServeOverload(LightGBMError):
 
 
 class _Request:
-    """One submitted predict request: rows + options + a completion event."""
+    """One submitted predict request: rows + options + a completion event.
+
+    When request tracing is on (``serve_trace``) the ingress mints a
+    ``trace_id`` that rides the request through the staging queue into the
+    flush's span breakdown and the sampled trace exemplars, so a response
+    can be correlated with its queue/bin/dispatch/readback timings."""
     __slots__ = ("x", "n", "model", "key", "enq_t", "out", "version",
-                 "exc", "_done")
+                 "exc", "trace_id", "_done")
 
     def __init__(self, x: np.ndarray, model: str, raw_score: bool,
                  pred_leaf: bool):
@@ -83,6 +91,7 @@ class _Request:
         self.out: Optional[np.ndarray] = None
         self.version = -1
         self.exc: Optional[BaseException] = None
+        self.trace_id: Optional[str] = None
         self._done = threading.Event()
 
     def _finish(self, out: np.ndarray, version: int) -> None:
@@ -123,6 +132,7 @@ class ServedModel:
         self.served_rows = 0
         self.retired = False
         self.retired_t = 0.0
+        self.published_t = time.time()   # wall clock: model-age freshness
 
 
 class ModelRegistry:
@@ -209,11 +219,14 @@ class ModelRegistry:
                  served_rows=int(sm.served_rows), drain_s=drain_s)
 
     def models(self) -> Dict[str, Dict]:
+        now = time.time()
         with self._lock:
             return {name: {"version": sm.version,
                            "n_trees": int(sm.engine.n_trees),
                            "inflight": sm.inflight,
-                           "served_rows": sm.served_rows}
+                           "served_rows": sm.served_rows,
+                           "published_t": sm.published_t,
+                           "age_s": round(now - sm.published_t, 3)}
                     for name, sm in self._models.items()}
 
 
@@ -228,7 +241,8 @@ class MicroBatcher:
 
     def __init__(self, registry: ModelRegistry, batch_window_us: int = 200,
                  queue_max: int = 8192, max_batch_rows: int = 1024,
-                 start: bool = True):
+                 start: bool = True, trace: bool = False,
+                 trace_sample: int = 16):
         if queue_max < 1:
             raise ValueError("serve_queue_max must be >= 1")
         if max_batch_rows < 1:
@@ -236,6 +250,8 @@ class MicroBatcher:
         self.registry = registry
         self._window_s = max(int(batch_window_us), 0) * 1e-6
         self._max_rows = int(max_batch_rows)
+        self._trace = bool(trace)
+        self._trace_sample = max(1, int(trace_sample))
         self._q: "queue.Queue[_Request]" = queue.Queue(maxsize=int(queue_max))
         self._stop = threading.Event()
         # host staging reused across flushes: (bucket, F) -> f64 features,
@@ -270,6 +286,8 @@ class MicroBatcher:
                 f"request of {x.shape[0]} rows exceeds serve_max_batch_rows="
                 f"{self._max_rows}; use Booster.predict for bulk batches")
         req = _Request(x, model, raw_score, pred_leaf)
+        if self._trace:
+            req.trace_id = tracing.mint_trace_id()
         try:
             self._q.put_nowait(req)
         except queue.Full:
@@ -432,18 +450,31 @@ class MicroBatcher:
         # in-place pseudo-binning into the reused staging buffer; rows past n
         # are stale from earlier flushes, which is fine — every kernel is
         # row-independent and run_binned slices to n before any host math
-        eng.router.bin_matrix(np.asarray(x[:n], dtype=np.float64),  # tpu-lint: disable=dtype-drift
-                              out=bins[:n])
-        out = eng.run_binned(bins, n, raw_score, pred_leaf, donate=True)
+        tracing_on = self._trace and obs.enabled()
+        trace: Optional[Dict[str, float]] = {} if tracing_on else None
+        try:
+            bin_t0 = time.perf_counter()
+            eng.router.bin_matrix(np.asarray(x[:n], dtype=np.float64),  # tpu-lint: disable=dtype-drift
+                                  out=bins[:n])
+            bin_s = time.perf_counter() - bin_t0
+            out = eng.run_binned(bins, n, raw_score, pred_leaf, donate=True,
+                                 trace=trace)
+        except Exception as e:
+            self._note_flush_fault(sm, reqs, trace, t0, e)
+            raise
         off = 0
         for r in reqs:
             r._finish(out[off: off + r.n], sm.version)
             off += r.n
+        done_t = time.perf_counter()
         with self._stats_lock:
             self.stats["flushes"] += 1
             self.stats["flushed_rows"] += n
+        if slo.TRACKER.active:
+            for r in reqs:
+                slo.TRACKER.observe(sm.name, done_t - r.enq_t)
         if obs.enabled():
-            dt = time.perf_counter() - t0
+            dt = done_t - t0
             wait_us = (t0 - min(r.enq_t for r in reqs)) * 1e6
             obs.emit("serve_flush", rows=n, requests=len(reqs), bucket=int(b),
                      model=sm.name, version=sm.version, wait_us=wait_us,
@@ -456,12 +487,53 @@ class MicroBatcher:
             obs.METRICS.gauge("serve_queue_depth",
                               "staging queue depth after drain").set(
                                   self._q.qsize())
-            done_t = time.perf_counter()
             h = obs.METRICS.histogram("serve_latency_seconds",
                                       "request latency (enqueue -> response)",
                                       model=sm.name, bucket=str(int(b)))
+            hr = obs.METRICS.histogram("request_latency_seconds",
+                                       "end-to-end request latency "
+                                       "(all buckets)", model=sm.name)
             for r in reqs:
                 h.observe(done_t - r.enq_t)
+                hr.observe(done_t - r.enq_t)
+        if tracing_on:
+            dd = trace.get("device_dispatch", 0.0)
+            rb = trace.get("readback", 0.0)
+            tracing.record_span("serve.bin", bin_s)
+            tracing.record_span("serve.device_dispatch", dd)
+            tracing.record_span("serve.readback", rb)
+            for r in reqs:
+                tracing.record_span("serve.queue_wait", t0 - r.enq_t)
+                tracing.TRACES.maybe_record(
+                    {"trace_id": r.trace_id, "model": sm.name,
+                     "version": sm.version, "rows": r.n, "bucket": int(b),
+                     "queue_wait_s": t0 - r.enq_t, "bin_s": bin_s,
+                     "device_dispatch_s": dd, "readback_s": rb,
+                     "total_s": done_t - r.enq_t},
+                    sample=self._trace_sample)
+
+    def _note_flush_fault(self, sm: ServedModel, reqs: List[_Request],
+                          trace: Optional[Dict[str, float]], t0: float,
+                          exc: BaseException) -> None:
+        """Device fault mid-flush: record the failing requests' span chains
+        into the flight recorder BEFORE emitting the device_fault event, so
+        the auto-trip dump already contains them."""
+        if not faults.is_device_fault(exc):
+            return
+        err = str(exc)[:200]
+        for r in reqs:
+            rec = {"trace_id": r.trace_id, "model": sm.name,
+                   "version": sm.version, "rows": r.n,
+                   "queue_wait_s": t0 - r.enq_t, "error": err}
+            if trace:
+                rec.update(trace)
+            flight.FLIGHT.note_span(rec)
+        obs.emit("device_fault", point=faults.classify_point(exc),
+                 policy="serve", action="fail_request", error=err)
+
+    def queue_depth(self) -> int:
+        """Current staging-queue depth (approximate; lock-free)."""
+        return self._q.qsize()
 
     def coalesce_factor(self) -> float:
         """Average rows per device dispatch on the coalesced path (>1 means
@@ -499,8 +571,16 @@ class PredictServer:
             batch_window_us=conf.serve_batch_window_us,
             queue_max=conf.serve_queue_max,
             max_batch_rows=conf.serve_max_batch_rows,
-            start=start)
+            start=start,
+            trace=conf.serve_trace,
+            trace_sample=conf.serve_trace_sample)
         self.online = None   # OnlineTrainer, via attach_online
+        slo.TRACKER.configure(slo_ms=conf.serve_slo_ms,
+                              target=conf.serve_slo_target,
+                              window=conf.serve_slo_window)
+        self._obs_http = obs_http.maybe_start(conf)
+        obs_http.add_status_section("serving", self._statusz)
+        obs.add_collector("serving", self._collect_metrics)
         if model is not None:
             self.publish(model, name=name)
 
@@ -509,6 +589,8 @@ class PredictServer:
         protocol command feeds it labeled rows; each refit cycle it triggers
         publishes back into this server's registry (zero-downtime swap)."""
         self.online = trainer
+        if hasattr(trainer, "statusz"):
+            obs_http.add_status_section("online", trainer.statusz)
 
     def _warmup_sizes(self) -> Tuple[int, ...]:
         """1 + every power-of-two bucket up to serve_max_batch_rows, so the
@@ -540,12 +622,62 @@ class PredictServer:
     def submit(self, x, **kw) -> _Request:
         return self.batcher.submit_async(x, **kw)
 
+    def _statusz(self) -> Dict:
+        """/statusz section: registry + queue (+ SLO when configured)."""
+        out = {"models": self.registry.models(),
+               "queue": self.batcher.snapshot()}
+        s = slo.TRACKER.snapshot()
+        if s:
+            out["slo"] = s
+        return out
+
+    def _collect_metrics(self, reg) -> None:
+        """Scrape-time derived gauges: model freshness + live queue depth."""
+        now = time.time()
+        for name, info in self.registry.models().items():
+            reg.gauge("model_age_seconds",
+                      "seconds since the serving version was published",
+                      model=name).set(now - info["published_t"])
+        reg.gauge("serve_queue_depth",
+                  "staging queue depth after drain").set(
+                      self.batcher.queue_depth())
+
+    def _latency_summary(self) -> Dict:
+        """p50/p95/p99 per model from the request-latency histogram."""
+        fam = obs.METRICS.get_family("request_latency_seconds")
+        if fam is None:
+            return {}
+        _, children = fam
+        out: Dict[str, Dict] = {}
+        for key, hist in children.items():
+            model = dict(key).get("model", "default")
+            snap = hist.snapshot()
+            qs = histogram_quantiles(snap, (0.5, 0.95, 0.99))
+            out[model] = {"p50_ms": round(qs[0.5] * 1e3, 3),
+                          "p95_ms": round(qs[0.95] * 1e3, 3),
+                          "p99_ms": round(qs[0.99] * 1e3, 3),
+                          "count": snap["count"]}
+        return out
+
     def stats(self) -> Dict:
-        return {"scheduler": self.batcher.snapshot(),
-                "models": self.registry.models()}
+        out = {"scheduler": self.batcher.snapshot(),
+               "models": self.registry.models()}
+        s = slo.TRACKER.snapshot()
+        if s:
+            out["slo"] = s
+        lat = self._latency_summary()
+        if lat:
+            out["latency"] = lat
+        return out
 
     def close(self, drain: bool = True) -> None:
         self.batcher.close(drain=drain)
+        obs.remove_collector("serving")
+        obs_http.remove_status_section("serving")
+        if self.online is not None:
+            obs_http.remove_status_section("online")
+        obs_http.stop(self._obs_http)
+        self._obs_http = None
 
 
 # ---- transports (task=serve): newline-delimited request protocol ----

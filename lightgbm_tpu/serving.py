@@ -42,6 +42,7 @@ import numpy as np
 from . import obs
 from .io.pseudo_bins import PseudoRouter
 from .ops import predict as P
+from .utils import faults
 
 # rows per streamed chunk; one executable serves every chunk (the tail is
 # padded up to the same shape). 128k rows x 28 features x 4B = ~14 MiB of
@@ -142,7 +143,8 @@ class PredictEngine:
 
     # ---- core ----
 
-    def _raw_padded(self, pbins, donate: bool = False) -> np.ndarray:
+    def _raw_padded(self, pbins, donate: bool = False,
+                    trace: Optional[Dict[str, float]] = None) -> np.ndarray:
         """Raw scores for a device bin matrix; [B] (k=1) or [B, k] float64.
 
         Mirrors ops/predict.ensemble_raw_scores exactly (same device kernels,
@@ -153,7 +155,11 @@ class PredictEngine:
         flush path). Only the k=1 dense path can donate — multiclass re-runs
         the kernel on the same pbins per class — and only on backends where
         donation is real (:data:`_CAN_DONATE`); the donating twin traces the
-        identical function, so the bits cannot differ."""
+        identical function, so the bits cannot differ.
+
+        ``trace`` (serve request tracing) collects host clock reads around
+        the existing calls — device_dispatch (async dispatch) vs readback
+        (the blocking np.asarray) — changing no device code whatsoever."""
         if self._class_dense is not None:
             if donate and self.k == 1 and _DENSE_DONATING is not None:
                 def fn(tables):
@@ -169,11 +175,27 @@ class PredictEngine:
                                                self.max_steps)
             tabs = [self._walk_tables(c) for c in range(self.k)]
         if self.k == 1:
-            raw = np.asarray(fn(tabs[0]), dtype=np.float64)
+            if trace is None:
+                raw = np.asarray(fn(tabs[0]), dtype=np.float64)
+            else:
+                t0 = time.perf_counter()
+                dev = fn(tabs[0])
+                t1 = time.perf_counter()
+                trace["device_dispatch"] = \
+                    trace.get("device_dispatch", 0.0) + (t1 - t0)
+                raw = np.asarray(dev, dtype=np.float64)
+                trace["readback"] = time.perf_counter() - t1
             return raw / self.n_trees if self.avg else raw
         out = np.zeros((pbins.shape[0], self.k))
+        t0 = time.perf_counter() if trace is not None else 0.0
         for cls in range(self.k):
             out[:, cls] = np.asarray(fn(tabs[cls]))
+        if trace is not None:
+            # multiclass interleaves per-class dispatch + readback: lump the
+            # whole loop into device_dispatch rather than misattribute
+            trace["device_dispatch"] = \
+                trace.get("device_dispatch", 0.0) + (time.perf_counter() - t0)
+            trace.setdefault("readback", 0.0)
         return out / (self.n_trees // self.k) if self.avg else out
 
     def _finish(self, raw: np.ndarray, n: int, raw_score: bool) -> np.ndarray:
@@ -184,13 +206,15 @@ class PredictEngine:
         return np.asarray(self.objective.convert_output(jnp.asarray(raw)))[:n]
 
     def run_binned(self, bins: np.ndarray, n: int, raw_score: bool = False,
-                   pred_leaf: bool = False, donate: bool = False
-                   ) -> np.ndarray:
+                   pred_leaf: bool = False, donate: bool = False,
+                   trace: Optional[Dict[str, float]] = None) -> np.ndarray:
         """Score an already pseudo-binned matrix: first ``n`` rows of
         ``bins`` are real, the rest (if any) is padding. Pads up to the
         power-of-two bucket and dispatches the bucket executable; with
         ``donate`` the uploaded device bin buffer is donated to XLA on
-        backends that support it (serve flush path — see server.py)."""
+        backends that support it (serve flush path — see server.py).
+        ``trace`` collects the device_dispatch/readback span breakdown for
+        request tracing (host clock reads only — see :meth:`_raw_padded`)."""
         if self.released:
             raise RuntimeError("PredictEngine used after release() — "
                                "retired model version")
@@ -202,12 +226,21 @@ class PredictEngine:
                 bins = bins[:b]
             else:
                 bins = np.pad(bins, ((0, b - bins.shape[0]), (0, 0)))
-        pbins = jax.device_put(bins)
+        # device chaos point for the serve-path H2D upload (inert unless
+        # armed), symmetric with the ingest.py chunk-transfer site
+        faults.fault_point("device_put_oom")
+        if trace is None:
+            pbins = jax.device_put(bins)
+        else:
+            t0 = time.perf_counter()
+            pbins = jax.device_put(bins)
+            trace["device_dispatch"] = time.perf_counter() - t0
         if pred_leaf:
             out = P.leaf_bins_ensemble(self._stack_full(), pbins,
                                        self.na_dev, self.max_steps)
             return np.asarray(out)[:n]
-        return self._finish(self._raw_padded(pbins, donate=donate),
+        return self._finish(self._raw_padded(pbins, donate=donate,
+                                             trace=trace),
                             n, raw_score)
 
     _run_bins = run_binned

@@ -31,7 +31,10 @@ point                     fires in
                           (kill-and-resume crash simulation)
 ``shard_commit``          ingest.py commit stage — before a chunk folds into
                           its owning shard's donated accumulator
-``device_put_oom``        ingest.py H2D stage — before the chunk transfer;
+``device_put_oom``        ingest.py H2D stage — before the chunk transfer —
+                          and serving.py run_binned — before the serve-path
+                          batch upload (a faulted flush fails its requests
+                          and trips the flight recorder, obs/flight.py);
                           raises the REAL XLA ``RESOURCE_EXHAUSTED`` error
                           type (simulated device OOM), so product catch
                           paths match on the exception they see in prod

@@ -70,7 +70,16 @@ def run(out_path=None, quick=False):
     queries = X[:4096]
 
     # ---- uncoalesced baseline: one dispatch per single-row request ----
-    srv = PredictServer({"verbose": -1}, model=booster)
+    # the sweep server runs with the live observability plane on: request
+    # tracing (span breakdown) + a latency SLO, so the bench records
+    # attainment and where the time goes, not just the percentiles
+    from lightgbm_tpu import obs
+    from lightgbm_tpu.obs import slo as obs_slo
+    from lightgbm_tpu.obs.metrics import histogram_quantiles
+    obs.configure(enabled=True)
+    srv = PredictServer({"verbose": -1, "serve_trace": True,
+                         "serve_trace_sample": 64, "serve_slo_ms": 50.0,
+                         "serve_slo_target": 0.99}, model=booster)
     eng = srv.registry.current().engine
     for _ in range(5):
         eng.predict(queries[:1])               # warm the n=1 bucket
@@ -129,10 +138,26 @@ def run(out_path=None, quick=False):
             "errors": errs[:3],
             **_percentiles(lat),
         }
+        slo_snap = obs_slo.TRACKER.snapshot().get("default")
+        if slo_snap:
+            point["slo_attainment"] = round(slo_snap["attainment"], 4)
+            point["slo_burn_rate"] = round(slo_snap["burn_rate"], 3)
         load_points.append(point)
         print(f"# {n_clients:3d} clients: {point['qps']:>9,.0f} qps  "
               f"p50 {point['p50_ms']:.2f}ms  p99 {point['p99_ms']:.2f}ms  "
               f"coalesce {point['coalesce_factor']}", file=sys.stderr)
+
+    # span breakdown: p50 per serve-path span across the whole sweep
+    span_breakdown = {}
+    fam = obs.METRICS.get_family("span_seconds")
+    if fam is not None:
+        for key, hist in fam[1].items():
+            name = dict(key).get("span", "")
+            if name.startswith("serve."):
+                q = histogram_quantiles(hist.snapshot(), (0.5,))
+                span_breakdown[name] = {
+                    "p50_ms": round(q[0.5] * 1e3, 4),
+                    "count": hist.snapshot()["count"]}
     srv.close()
 
     # ---- overload: bounded queue sheds, admitted requests all complete ----
@@ -172,6 +197,7 @@ def run(out_path=None, quick=False):
         "uncoalesced_single_row_rps": round(uncoalesced_rps, 1),
         "recorded_tpu_uncoalesced_rps": 31.0,
         "load_points": load_points,
+        "span_breakdown": span_breakdown,
         "overload": overload,
         "best_qps": best_qps,
         "speedup_vs_uncoalesced": round(best_qps / uncoalesced_rps, 2),

@@ -870,3 +870,139 @@ def test_online_module_in_shared_state_scope():
     # outside the threaded scope the same mutation is the normal idiom
     assert "unlocked-shared-state" not in names(
         analyze_source(ONLINE_STATS_BAD, relpath="lightgbm_tpu/basic.py"))
+
+
+# ---- observability-plane rule scopes (PR: live obs plane) ----
+# The obs plane added modules that EMIT real telemetry (slo.py, flight.py,
+# http_server.py) and a background flusher loop (obs/__init__._flush_loop):
+# the telemetry-schema skip list narrows from all of obs/ to just the
+# plumbing files, and the flusher joins the scheduler-loop audit (it must
+# wait on its stop event, never a bare sleep).
+
+OBS_INIT_REL = "lightgbm_tpu/obs/__init__.py"
+
+FLUSH_LOOP_BAD = """
+import time
+
+def _flush_loop(interval_s, stop):
+    while not stop.is_set():
+        time.sleep(interval_s)
+        export_all()
+"""
+
+FLUSH_LOOP_SUPPRESSED = """
+import time
+
+def _flush_loop(interval_s, stop):
+    while not stop.is_set():
+        # simulation harness: wall-clock pacing IS the experiment
+        time.sleep(interval_s)   # tpu-lint: disable=host-sync-in-jit
+        export_all()
+"""
+
+FLUSH_LOOP_CLEAN = """
+def _flush_loop(interval_s, stop):
+    while not stop.wait(interval_s):
+        export_all()
+"""
+
+
+def test_flush_loop_blocking_calls_fire():
+    found = analyze_source(FLUSH_LOOP_BAD, relpath=OBS_INIT_REL)
+    assert any(f.rule == "host-sync-in-jit" and "sleep" in f.message
+               for f in found)
+    # _flush_loop elsewhere is not a designated scheduler loop
+    assert "host-sync-in-jit" not in names(
+        analyze_source(FLUSH_LOOP_BAD, relpath="lightgbm_tpu/basic.py"))
+
+
+def test_flush_loop_suppressed_and_clean():
+    assert "host-sync-in-jit" not in names(
+        analyze_source(FLUSH_LOOP_SUPPRESSED, relpath=OBS_INIT_REL))
+    assert "host-sync-in-jit" in names(
+        analyze_source(FLUSH_LOOP_SUPPRESSED, relpath=OBS_INIT_REL,
+                       keep_suppressed=True))
+    # the shipped idiom — wait on the stop event, bounded — is clean
+    assert "host-sync-in-jit" not in names(
+        analyze_source(FLUSH_LOOP_CLEAN, relpath=OBS_INIT_REL))
+
+
+OBS_EMIT_BAD = """
+def dump(reason):
+    from . import emit
+    emit("flight_dump", reason=reason, events=1, bogus_field_xyz=2)
+"""
+
+OBS_EMIT_SUPPRESSED = """
+def dump(reason):
+    from . import emit
+    emit("flight_dump", reason=reason, events=1, bogus_field_xyz=2)  # tpu-lint: disable=telemetry-schema
+"""
+
+OBS_EMIT_CLEAN = """
+def dump(reason):
+    from . import emit
+    emit("flight_dump", reason=reason, events=1, spans=0, path="p")
+"""
+
+
+def test_telemetry_schema_covers_obs_emitting_modules():
+    # the emitting obs modules are IN scope after the skip-list narrowing
+    for rel in ("lightgbm_tpu/obs/flight.py", "lightgbm_tpu/obs/slo.py",
+                "lightgbm_tpu/obs/http_server.py"):
+        fs = analyze_source(OBS_EMIT_BAD, relpath=rel)
+        assert any(f.rule == "telemetry-schema" and "bogus_field_xyz"
+                   in f.message for f in fs), rel
+    assert "telemetry-schema" not in names(
+        analyze_source(OBS_EMIT_SUPPRESSED,
+                       relpath="lightgbm_tpu/obs/flight.py"))
+    assert "telemetry-schema" in names(
+        analyze_source(OBS_EMIT_SUPPRESSED,
+                       relpath="lightgbm_tpu/obs/flight.py",
+                       keep_suppressed=True))
+    assert "telemetry-schema" not in names(
+        analyze_source(OBS_EMIT_CLEAN, relpath="lightgbm_tpu/obs/flight.py"))
+
+
+def test_telemetry_schema_still_skips_obs_plumbing():
+    # the delegating emit wrapper (non-literal etype) lives in plumbing
+    # modules that stay out of scope
+    wrapper = ('def emit(etype, **fields):\n'
+               '    EVENTS.emit(etype, **fields)\n')
+    for rel in ("lightgbm_tpu/obs/__init__.py",
+                "lightgbm_tpu/obs/events.py"):
+        assert "telemetry-schema" not in names(
+            analyze_source(wrapper, relpath=rel)), rel
+    # the same dynamic-etype call in an emitting obs module DOES fire
+    assert "telemetry-schema" in names(
+        analyze_source(wrapper, relpath="lightgbm_tpu/obs/flight.py"))
+
+
+OBS_SERVER_SINGLETON_BAD = """
+_SERVER = None
+
+def maybe_start(conf):
+    global _SERVER
+    _SERVER = build(conf)
+    return _SERVER
+"""
+
+OBS_SERVER_SINGLETON_LOCKED = """
+import threading
+_server_lock = threading.Lock()
+_SERVER = None
+
+def maybe_start(conf):
+    global _SERVER
+    with _server_lock:
+        _SERVER = build(conf)
+        return _SERVER
+"""
+
+
+def test_obs_http_singleton_in_shared_state_scope():
+    rel = "lightgbm_tpu/obs/http_server.py"
+    assert "unlocked-shared-state" in names(
+        analyze_source(OBS_SERVER_SINGLETON_BAD, relpath=rel))
+    assert "unlocked-shared-state" not in names(
+        analyze_source(OBS_SERVER_SINGLETON_LOCKED, relpath=rel))
