@@ -122,6 +122,28 @@ def _lint_preflight():
         sys.exit(f"bench aborted: tpu-lint found "
                  f"{doc.get('summary', {}).get('findings', '?')} violation(s)"
                  " — fix them (or LGBM_TPU_BENCH_SKIP_LINT=1 to bypass)")
+    # compile-budget gate: the rule itself launches the jax probe in its own
+    # fresh subprocess, so this parent stays jax-free too. A bench run whose
+    # warm path lowers more programs than LOWERING_BUDGET.json is measuring
+    # the regression, not the tree — fail before burning TPU minutes.
+    proc = subprocess.run(
+        [sys.executable, "-m", "lightgbm_tpu.analysis", "--dynamic",
+         "--rules=compile-budget", "--format=json",
+         "--severity-threshold=error"],
+        cwd=os.path.dirname(os.path.abspath(__file__)), env=env,
+        capture_output=True, text=True)
+    if proc.returncode != 0:
+        doc = {}
+        try:
+            doc = json.loads(proc.stdout)
+        except ValueError:
+            pass
+        for f in doc.get("findings", []):
+            print(f"# tpu-lint {f['path']}:{f['line']}: [{f['rule']}] "
+                  f"{f['message']}", file=sys.stderr)
+        sys.exit("bench aborted: compile-budget regression — fix it, rerun "
+                 "`python -m lightgbm_tpu.analysis --update-budget` if "
+                 "deliberate, or LGBM_TPU_BENCH_SKIP_LINT=1 to bypass")
 
 
 def main():

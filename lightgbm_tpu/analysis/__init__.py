@@ -7,12 +7,14 @@ the suppression/baseline workflow.
 """
 from .core import (AnalysisResult, BaselineEntry, Finding, ModuleContext,
                    Rule, all_rules, analyze_paths, analyze_source,
-                   event_schemas, load_baseline, main, nonfinite_policies,
-                   register, registered_params, render_human, render_json)
+                   changed_files, event_schemas, load_baseline, main,
+                   nonfinite_policies, register, registered_params,
+                   render_human, render_json, render_sarif)
 
 __all__ = [
     "AnalysisResult", "BaselineEntry", "Finding", "ModuleContext", "Rule",
-    "all_rules", "analyze_paths", "analyze_source", "event_schemas",
-    "load_baseline", "main", "nonfinite_policies", "register",
-    "registered_params", "render_human", "render_json",
+    "all_rules", "analyze_paths", "analyze_source", "changed_files",
+    "event_schemas", "load_baseline", "main", "nonfinite_policies",
+    "register", "registered_params", "render_human", "render_json",
+    "render_sarif",
 ]

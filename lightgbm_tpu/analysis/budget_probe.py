@@ -1,0 +1,77 @@
+"""Measure distinct jit lowerings per warmed entry point.
+
+Run as ``python -m lightgbm_tpu.analysis.budget_probe`` in a FRESH process
+(the compile-budget rule and ``--update-budget`` both launch it via
+subprocess): jit caches are process-global, so in-process measurement would
+credit earlier work against later entries. Prints a single JSON line
+``{"counts": {...}}`` on stdout.
+
+Workload is fixed and tiny (512x16, 7 leaves, 3 iters, binary objective,
+prewarm off) so the counts are exact, deterministic, and CPU-cheap. The
+``predict_warm_repeat`` entry re-runs predict on the same shapes and MUST
+measure 0 — it is the per-call-jit canary: any lowering there means a jit
+wrapper is being rebuilt per call instead of reused.
+"""
+from __future__ import annotations
+
+import json
+import os
+import sys
+
+
+def measure() -> dict:
+    os.environ.setdefault("JAX_PLATFORMS", "cpu")
+    # the persistent compile cache skips lowering-count measurement neither
+    # way (counters hook lowering, not compilation), but keep the run
+    # hermetic: no telemetry, no lint-only mode
+    os.environ.pop("LGBMTPU_LINT_ONLY", None)
+
+    import numpy as np
+    import jax  # noqa: F401  (force backend init before counting)
+    import jax._src.test_util as jtu
+
+    import lightgbm_tpu as lgb
+
+    rng = np.random.RandomState(0)
+    X = rng.rand(512, 16).astype(np.float32)
+    y = (rng.rand(512) > 0.5).astype(np.float32)
+    params = {"objective": "binary", "num_leaves": 7, "max_bin": 31,
+              "min_data_in_leaf": 5, "verbosity": -1, "prewarm": 0}
+
+    counts = {}
+
+    # warm the trivial-jit plumbing (device placement, singleton helpers) so
+    # entry-point counts measure the entry point, not backend bring-up
+    # one-shot by construction (runs once per probe process)
+    jax.jit(lambda a: a + 1)(np.float32(0)).block_until_ready()  # tpu-lint: disable=retrace-hazard
+
+    with jtu.count_jit_and_pmap_lowerings() as n:
+        train_set = lgb.Dataset(X, label=y, params=params)
+        train_set.construct()
+    counts["dataset_construct"] = int(n[0])
+
+    with jtu.count_jit_and_pmap_lowerings() as n:
+        booster = lgb.train(params, train_set, num_boost_round=3)
+    counts["train_3_iters"] = int(n[0])
+
+    with jtu.count_jit_and_pmap_lowerings() as n:
+        booster.predict(X)
+    counts["predict_cold"] = int(n[0])
+
+    with jtu.count_jit_and_pmap_lowerings() as n:
+        for _ in range(3):
+            booster.predict(X)
+    counts["predict_warm_repeat"] = int(n[0])
+
+    return counts
+
+
+def main() -> int:
+    counts = measure()
+    json.dump({"counts": counts}, sys.stdout)
+    sys.stdout.write("\n")
+    return 0
+
+
+if __name__ == "__main__":
+    sys.exit(main())

@@ -75,7 +75,10 @@ class Finding:
 class Rule:
     """One hazard class. Subclasses set ``name``/``severity``/``description``
     /``rationale`` and implement :meth:`check_module` (AST rules) or
-    :meth:`run_dynamic` (runtime smoke rules, gated behind ``--dynamic``)."""
+    :meth:`run_dynamic` (runtime smoke rules, gated behind ``--dynamic``).
+    Rules that need the repo-wide pass-1 facts (lock graphs span modules)
+    additionally implement :meth:`check_repo`, called once after every
+    module has been analyzed."""
 
     name: str = ""
     severity: str = "error"
@@ -85,6 +88,10 @@ class Rule:
 
     def check_module(self, ctx: "ModuleContext") -> None:
         raise NotImplementedError
+
+    def check_repo(self, facts, emit) -> None:
+        """Cross-module pass: ``facts`` is a ``facts.RepoFacts``; report via
+        ``emit(path, line, message, severity=None)``. Default: nothing."""
 
     def run_dynamic(self) -> List[Finding]:   # pragma: no cover - per rule
         raise NotImplementedError
@@ -133,6 +140,10 @@ class ModuleContext:
             _import_aliases(self.tree)
         self.line_suppressions, self.file_suppressions = \
             _parse_suppressions(source)
+        # pass-1 facts, attached by the driver before rules run: this
+        # module's ``facts.ModuleFacts`` and the repo-wide ``RepoFacts``
+        self.facts = None
+        self.repo_facts = None
 
     # -- reporting --
     def report(self, rule: Rule, node: Any, message: str,
@@ -457,25 +468,41 @@ class AnalysisResult:
     parse_errors: List[Finding]
     files: int
     elapsed_s: float
+    # exit-code semantics: "warn" fails on ANY live finding (the strict
+    # default, and the historical behavior); "error" lets warning-severity
+    # findings through (reported, but exit 0) so advisory rules can ride
+    # along without breaking tier-1 / bench preflight
+    threshold: str = "warn"
+
+    @property
+    def errors(self) -> List[Finding]:
+        return [f for f in self.findings if f.severity == "error"]
+
+    @property
+    def warnings(self) -> List[Finding]:
+        return [f for f in self.findings if f.severity != "error"]
 
     @property
     def failed(self) -> bool:
-        return bool(self.findings or self.parse_errors
-                    or self.stale_baseline)
+        gating = self.findings if self.threshold == "warn" else self.errors
+        return bool(gating or self.parse_errors or self.stale_baseline)
 
     def to_dict(self) -> Dict[str, Any]:
         return {
-            "version": 1,
+            "version": 2,
             "findings": [f.to_dict() for f in self.findings],
             "parse_errors": [f.to_dict() for f in self.parse_errors],
             "stale_baseline": [e.to_dict() for e in self.stale_baseline],
             "summary": {
                 "files": self.files,
                 "findings": len(self.findings),
+                "errors": len(self.errors),
+                "warnings": len(self.warnings),
                 "suppressed": len(self.suppressed),
                 "baselined": len(self.baselined),
                 "stale_baseline": len(self.stale_baseline),
                 "elapsed_s": round(self.elapsed_s, 3),
+                "threshold": self.threshold,
                 "ok": not self.failed,
             },
         }
@@ -503,23 +530,38 @@ def iter_python_files(paths: Sequence[str], root: str = REPO_ROOT) \
 def analyze_source(source: str, relpath: str = "<fixture>",
                    rules: Optional[Sequence[str]] = None,
                    keep_suppressed: bool = False) -> List[Finding]:
-    """Analyze one source string (the fixture-test entry point). Returns
-    live findings; with ``keep_suppressed`` returns suppressed ones too."""
-    live, suppressed = _analyze_module(relpath, source, _select(rules))
+    """Analyze one source string (the fixture-test entry point). Runs both
+    passes — facts are built from the single module, and ``check_repo``
+    rules (lock-order) see a one-module repo — so fixture trios exercise the
+    cross-module rules too. Returns live findings; with ``keep_suppressed``
+    returns suppressed ones too."""
+    from . import facts as facts_mod
+    chosen = _select(rules)
+    ctx = ModuleContext(relpath, source)
+    repo = facts_mod.build_repo_facts([(ctx.relpath, ctx.tree)])
+    ctx.facts = repo.modules[ctx.relpath]
+    ctx.repo_facts = repo
+    _run_rules(ctx, chosen)
+    _run_repo_rules(repo, chosen, {ctx.relpath: ctx})
+    live, suppressed = _split_findings(ctx)
     return live + (suppressed if keep_suppressed else [])
 
 
 def analyze_paths(paths: Optional[Sequence[str]] = None,
                   rules: Optional[Sequence[str]] = None,
                   baseline_path: Optional[str] = DEFAULT_BASELINE,
-                  root: str = REPO_ROOT) -> AnalysisResult:
+                  root: str = REPO_ROOT,
+                  severity_threshold: str = "warn") -> AnalysisResult:
+    """Two-pass repo scan. Pass 1 parses every module and builds the
+    repo-wide facts (lock graph raw material, donation wrappers, shard_map
+    bodies, collective axis uses); pass 2 runs the per-module rules with
+    those facts attached, then the cross-module ``check_repo`` rules."""
+    from . import facts as facts_mod
     t0 = time.perf_counter()
     chosen = _select(rules)
     files = iter_python_files(paths or DEFAULT_PATHS, root=root)
-    live: List[Finding] = []
-    suppressed: List[Finding] = []
     parse_errors: List[Finding] = []
-    code_of: Dict[Finding, str] = {}
+    ctxs: Dict[str, ModuleContext] = {}
     for full in files:
         rel = os.path.relpath(full, root).replace(os.sep, "/")
         try:
@@ -530,12 +572,23 @@ def analyze_paths(paths: Optional[Sequence[str]] = None,
                                         f"unreadable: {e}", "error"))
             continue
         try:
-            file_live, file_supp = _analyze_module(rel, src, chosen,
-                                                   code_of=code_of)
+            ctxs[rel] = ModuleContext(rel, src)
         except SyntaxError as e:
             parse_errors.append(Finding("parse", rel, e.lineno or 1,
                                         f"does not parse: {e.msg}", "error"))
-            continue
+
+    repo = facts_mod.build_repo_facts(
+        [(rel, ctx.tree) for rel, ctx in ctxs.items()])
+    live: List[Finding] = []
+    suppressed: List[Finding] = []
+    code_of: Dict[Finding, str] = {}
+    for rel, ctx in ctxs.items():
+        ctx.facts = repo.modules[rel]
+        ctx.repo_facts = repo
+        _run_rules(ctx, chosen)
+    _run_repo_rules(repo, chosen, ctxs)
+    for ctx in ctxs.values():
+        file_live, file_supp = _split_findings(ctx, code_of=code_of)
         live.extend(file_live)
         suppressed.extend(file_supp)
 
@@ -553,11 +606,15 @@ def analyze_paths(paths: Optional[Sequence[str]] = None,
             baselined.append(f)
         else:
             remaining.append(f)
-    stale = [e for e in baseline if id(e) not in matched]
+    # a baseline entry only goes stale if its file was actually scanned —
+    # a --changed-only run must not declare every out-of-scope entry stale
+    stale = [e for e in baseline
+             if id(e) not in matched and e.path in ctxs]
     return AnalysisResult(findings=remaining, suppressed=suppressed,
                           baselined=baselined, stale_baseline=stale,
                           parse_errors=parse_errors, files=len(files),
-                          elapsed_s=time.perf_counter() - t0)
+                          elapsed_s=time.perf_counter() - t0,
+                          threshold=severity_threshold)
 
 
 def _select(rules: Optional[Sequence[str]]) -> List[Rule]:
@@ -571,12 +628,30 @@ def _select(rules: Optional[Sequence[str]]) -> List[Rule]:
     return [table[n] for n in rules if table[n].kind == "ast"]
 
 
-def _analyze_module(relpath: str, source: str, rules: List[Rule],
-                    code_of: Optional[Dict[Finding, str]] = None) \
-        -> Tuple[List[Finding], List[Finding]]:
-    ctx = ModuleContext(relpath, source)
+def _run_rules(ctx: ModuleContext, rules: List[Rule]) -> None:
     for rule in rules:
         rule.check_module(ctx)
+
+
+def _run_repo_rules(repo_facts, rules: List[Rule],
+                    ctxs: Dict[str, ModuleContext]) -> None:
+    """Run each rule's cross-module pass; findings land on the owning
+    module's context so the normal suppression filter applies to them."""
+    for rule in rules:
+        def emit(path: str, line: int, message: str,
+                 severity: Optional[str] = None, _rule=rule) -> None:
+            ctx = ctxs.get(path)
+            if ctx is None:      # site outside the scanned set: anchor to
+                ctx = next(iter(ctxs.values()))   # any module (best effort)
+            ctx.findings.append(Finding(
+                rule=_rule.name, path=path, line=line, message=message,
+                severity=severity or _rule.severity))
+        rule.check_repo(repo_facts, emit)
+
+
+def _split_findings(ctx: ModuleContext,
+                    code_of: Optional[Dict[Finding, str]] = None) \
+        -> Tuple[List[Finding], List[Finding]]:
     live, suppressed = [], []
     for f in sorted(ctx.findings, key=lambda f: (f.line, f.rule)):
         if code_of is not None:
@@ -592,7 +667,8 @@ def _analyze_module(relpath: str, source: str, rules: List[Rule],
 def render_human(res: AnalysisResult) -> str:
     lines: List[str] = []
     for f in res.parse_errors + res.findings:
-        lines.append("FAIL " + f.render())
+        gates = f.severity == "error" or res.threshold == "warn"
+        lines.append(("FAIL " if gates else "WARN ") + f.render())
     for e in res.stale_baseline:
         lines.append(f"FAIL {e.path}:{e.line}: [error] stale-baseline: "
                      f"baseline entry for rule {e.rule!r} no longer matches "
@@ -609,6 +685,77 @@ def render_human(res: AnalysisResult) -> str:
 
 def render_json(res: AnalysisResult) -> str:
     return json.dumps(res.to_dict(), sort_keys=True)
+
+
+def render_sarif(res: AnalysisResult) -> str:
+    """SARIF 2.1.0 document for CI annotation (one run, findings + parse
+    errors as results; rule metadata from the registry)."""
+    table = all_rules()
+    rules_meta = [
+        {"id": name,
+         "shortDescription": {"text": rule.description or name},
+         "fullDescription": {"text": rule.rationale or rule.description},
+         "defaultConfiguration": {
+             "level": "error" if rule.severity == "error" else "warning"}}
+        for name, rule in sorted(table.items())]
+    results = []
+    for f in res.parse_errors + res.findings:
+        results.append({
+            "ruleId": f.rule,
+            "level": "error" if f.severity == "error" else "warning",
+            "message": {"text": f.message},
+            "locations": [{"physicalLocation": {
+                "artifactLocation": {"uri": f.path},
+                "region": {"startLine": max(1, f.line)}}}],
+        })
+    for e in res.stale_baseline:
+        results.append({
+            "ruleId": "stale-baseline",
+            "level": "error",
+            "message": {"text": f"baseline entry for rule {e.rule!r} no "
+                                f"longer matches any finding (code was: "
+                                f"{e.code!r})"},
+            "locations": [{"physicalLocation": {
+                "artifactLocation": {"uri": e.path},
+                "region": {"startLine": max(1, e.line)}}}],
+        })
+    doc = {
+        "version": "2.1.0",
+        "$schema": "https://json.schemastore.org/sarif-2.1.0.json",
+        "runs": [{
+            "tool": {"driver": {"name": "tpu-lint",
+                                "informationUri":
+                                    "docs/STATIC_ANALYSIS.md",
+                                "rules": rules_meta}},
+            "results": results,
+        }],
+    }
+    return json.dumps(doc, sort_keys=True)
+
+
+def changed_files(root: str = REPO_ROOT) -> Optional[List[str]]:
+    """Repo-relative .py files with uncommitted changes (staged, unstaged,
+    or untracked), for ``--changed-only``. None when git is unavailable."""
+    import subprocess
+    try:
+        proc = subprocess.run(["git", "status", "--porcelain=v1", "-uall"],
+                              cwd=root, capture_output=True, text=True,
+                              timeout=30)
+    except (OSError, subprocess.TimeoutExpired):
+        return None
+    if proc.returncode != 0:
+        return None
+    out: List[str] = []
+    for ln in proc.stdout.splitlines():
+        if len(ln) < 4 or ln.startswith("D "):
+            continue
+        p = ln[3:]
+        if " -> " in p:                      # rename: scan the new name
+            p = p.split(" -> ")[-1]
+        p = p.strip().strip('"')
+        if p.endswith(".py"):
+            out.append(p)
+    return out
 
 
 def _update_baseline(res: AnalysisResult, baseline_path: str,
@@ -655,7 +802,8 @@ def main(argv: Optional[Sequence[str]] = None) -> int:
         description="tpu-lint: static analysis for JAX/TPU GBDT hazards")
     ap.add_argument("paths", nargs="*", default=None,
                     help="files/dirs to scan (default: the repo surface)")
-    ap.add_argument("--format", choices=("human", "json"), default="human")
+    ap.add_argument("--format", choices=("human", "json", "sarif"),
+                    default="human")
     ap.add_argument("--rules", default=None,
                     help="comma-separated rule subset")
     ap.add_argument("--baseline", default=DEFAULT_BASELINE,
@@ -663,9 +811,21 @@ def main(argv: Optional[Sequence[str]] = None) -> int:
     ap.add_argument("--update-baseline", action="store_true",
                     help="rewrite the baseline from current findings")
     ap.add_argument("--list-rules", action="store_true")
+    ap.add_argument("--changed-only", action="store_true",
+                    help="scan only files with uncommitted git changes "
+                         "(sub-second pre-commit mode; cross-module rules "
+                         "see only the changed files)")
+    ap.add_argument("--severity-threshold", choices=("warn", "error"),
+                    default="warn",
+                    help="'warn' (default) fails on any finding; 'error' "
+                         "reports warnings but only errors set exit 1")
     ap.add_argument("--dynamic", action="store_true",
                     help="also run dynamic (runtime smoke) rules; these "
-                         "import the package, and therefore JAX")
+                         "import the package (nonfinite smoke) or spawn a "
+                         "probe subprocess (compile-budget)")
+    ap.add_argument("--update-budget", action="store_true",
+                    help="re-measure the compile-budget entry points and "
+                         "rewrite LOWERING_BUDGET.json")
     args = ap.parse_args(argv)
 
     if args.list_rules:
@@ -674,16 +834,31 @@ def main(argv: Optional[Sequence[str]] = None) -> int:
                   f"{rule.description}")
         return 0
 
+    if args.update_budget:
+        from .rules import compile_budget as _cb
+        return _cb.update_budget_cli()
+
     rules = [r.strip() for r in args.rules.split(",")] if args.rules else None
     baseline = None if args.baseline == "none" else args.baseline
+    paths = args.paths or None
+    if args.changed_only:
+        changed = changed_files(REPO_ROOT)
+        if changed is None:
+            print("tpu-lint: --changed-only needs git; falling back to a "
+                  "full scan", flush=True)
+        else:
+            surface = set(iter_python_files(paths or DEFAULT_PATHS))
+            paths = [p for p in changed
+                     if os.path.join(REPO_ROOT, p) in surface]
+            if not paths:
+                print("PASS tpu-lint: no changed files on the scan surface")
+                return 0
     if args.update_baseline:
-        res = analyze_paths(args.paths or None, rules=rules,
-                            baseline_path=None)
+        res = analyze_paths(paths, rules=rules, baseline_path=None)
         return _update_baseline(res, baseline or DEFAULT_BASELINE, REPO_ROOT)
 
-    res = analyze_paths(args.paths or None, rules=rules,
-                        baseline_path=baseline)
-    rc = 1 if res.failed else 0
+    res = analyze_paths(paths, rules=rules, baseline_path=baseline,
+                        severity_threshold=args.severity_threshold)
     if args.dynamic:
         dyn_findings: List[Finding] = []
         for rule in all_rules().values():
@@ -691,6 +866,7 @@ def main(argv: Optional[Sequence[str]] = None) -> int:
                 continue
             dyn_findings.extend(rule.run_dynamic())
         res.findings.extend(dyn_findings)
-        rc = 1 if res.failed else rc
-    print(render_json(res) if args.format == "json" else render_human(res))
+    rc = 1 if res.failed else 0
+    print(render_sarif(res) if args.format == "sarif" else
+          render_json(res) if args.format == "json" else render_human(res))
     return rc

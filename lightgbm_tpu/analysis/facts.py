@@ -1,0 +1,452 @@
+"""tpu-lint pass 1: per-module facts for the dataflow-aware rule families.
+
+The v1 analyzer ran every rule as an independent per-line visitor; the v2
+engine runs two passes. This module is the first: it walks each module ONCE
+and extracts the cross-cutting facts the concurrency/XLA rules need —
+
+- the lock landscape: every ``threading.Lock()``/``RLock()`` creation site
+  (module-level, ``self._lock = ...`` class attributes, function locals),
+  every ``with <lock>:`` acquisition with the set of locks already held at
+  that point, and every call made while holding a lock (the raw material for
+  the cross-module acquisition-order graph);
+- jit / shard_map boundaries: which functions are jitted, and which function
+  bodies execute inside a ``shard_map`` (collectives are legal there, host
+  callbacks are suspect);
+- donated-argument sets: ``jax.jit(..., donate_argnums=...)`` wrappers and
+  decorated defs, by name, with the donated positional indices;
+- collective axis uses: every ``psum``/``all_gather``/... call with its
+  ``axis_name`` argument (literal or not).
+
+Like everything in ``analysis/``, this is pure stdlib ``ast`` — no JAX, no
+package imports. Identity conventions: a lock is ``"<relpath>::<name>"`` for
+module-level locks, ``"<relpath>::<Class>.<attr>"`` for instance locks, and
+``"<relpath>::<func>.<name>"`` for function locals, so the same source lock
+gets the same node in the repo-wide graph no matter which module acquires it.
+"""
+from __future__ import annotations
+
+import ast
+import dataclasses
+import os
+from typing import Dict, List, Optional, Sequence, Set, Tuple
+
+_LOCK_FACTORIES = {"Lock", "RLock", "allocate_lock"}
+_COLLECTIVES = {"psum", "pmean", "pmax", "pmin", "all_gather", "all_to_all",
+                "ppermute", "psum_scatter", "axis_index"}
+_SHARD_MAP_NAMES = {"shard_map", "shard_map_compat"}
+
+
+@dataclasses.dataclass(frozen=True)
+class LockDef:
+    lock_id: str
+    kind: str          # "Lock" | "RLock" | "unknown"
+    path: str
+    line: int
+
+
+@dataclasses.dataclass(frozen=True)
+class Acquire:
+    lock_id: str
+    line: int
+    held: Tuple[str, ...]     # lock ids already held (lexically) at this site
+
+
+@dataclasses.dataclass(frozen=True)
+class CallSite:
+    name: str                 # bare name or method attr
+    line: int
+    held: Tuple[str, ...]
+    is_method: bool
+    # who the method was called on: None (bare call), "self",
+    # "NAME" (a plain-name receiver: singleton, module or local),
+    # "self.attr" (an instance attribute), "mod.NAME" (a module-qualified
+    # singleton), or "?" (anything more complex — unresolvable)
+    receiver: Optional[str] = None
+
+
+@dataclasses.dataclass
+class FunctionFacts:
+    module: str               # relpath
+    qual: str                 # "func" or "Class.method"
+    line: int
+    acquires: List[Acquire]
+    calls: List[CallSite]
+
+    @property
+    def name(self) -> str:
+        return self.qual.rsplit(".", 1)[-1]
+
+
+@dataclasses.dataclass(frozen=True)
+class CollectiveUse:
+    op: str
+    axis: Optional[str]       # literal axis name, None when non-literal
+    line: int
+    in_shard_map: bool
+
+
+@dataclasses.dataclass
+class ModuleFacts:
+    relpath: str
+    lock_defs: Dict[str, LockDef]              # lock_id -> def
+    functions: Dict[str, FunctionFacts]        # qual -> facts
+    donating: Dict[str, Tuple[int, ...]]       # wrapper name -> donated arg idx
+    jit_functions: List[Tuple[str, int]]       # (name, line)
+    shard_map_bodies: List[Tuple[str, ast.AST]]  # (label, body AST)
+    collective_uses: List[CollectiveUse]
+    instance_of: Dict[str, str]                # module var -> class name
+    attr_instance_of: Dict[Tuple[str, str], str]  # (cls, attr) -> class name
+
+    def lock_kind(self, lock_id: str) -> str:
+        d = self.lock_defs.get(lock_id)
+        return d.kind if d else "unknown"
+
+
+@dataclasses.dataclass
+class RepoFacts:
+    modules: Dict[str, ModuleFacts]
+    mesh_axes: Set[str]
+
+    def all_functions(self) -> List[FunctionFacts]:
+        return [f for m in self.modules.values()
+                for f in m.functions.values()]
+
+    def lock_kind(self, lock_id: str) -> str:
+        path = lock_id.split("::", 1)[0]
+        m = self.modules.get(path)
+        return m.lock_kind(lock_id) if m else "unknown"
+
+
+# ---------------------------------------------------------------------------
+# per-module extraction
+
+
+def _is_lock_factory_call(node: ast.AST) -> Optional[str]:
+    """``threading.Lock()`` / ``RLock()`` / ``_thread.allocate_lock()`` ->
+    the lock kind, else None."""
+    if not isinstance(node, ast.Call):
+        return None
+    f = node.func
+    name = f.attr if isinstance(f, ast.Attribute) else \
+        f.id if isinstance(f, ast.Name) else ""
+    if name in _LOCK_FACTORIES:
+        return "Lock" if name == "allocate_lock" else name
+    return None
+
+
+def _donated_positions(call: ast.Call) -> Optional[Tuple[int, ...]]:
+    """Donated positional indices from a ``jax.jit(...)`` call's
+    ``donate_argnums`` keyword (int or tuple literal)."""
+    for kw in call.keywords:
+        if kw.arg == "donate_argnums":
+            idx = [s.value for s in ast.walk(kw.value)
+                   if isinstance(s, ast.Constant) and isinstance(s.value, int)]
+            return tuple(sorted(set(idx)))
+    return None
+
+
+def _jit_calls_in(node: ast.AST):
+    """Yield every ``jax.jit(...)`` / ``partial(jax.jit, ...)`` Call in the
+    expression (unwraps IfExp arms, e.g. ``jit(...) if CAN else None``)."""
+    from .core import jit_call_info
+    for sub in ast.walk(node):
+        call = jit_call_info(sub)
+        if call is not None:
+            yield call
+
+
+class _ModuleFactsBuilder(ast.NodeVisitor):
+    """Single walk collecting lock defs/acquisitions, calls-under-lock,
+    donation wrappers, jit boundaries, shard_map bodies and collectives."""
+
+    def __init__(self, relpath: str, tree: ast.Module):
+        self.relpath = relpath
+        self.tree = tree
+        self.lock_defs: Dict[str, LockDef] = {}
+        self.class_locks: Dict[Tuple[str, str], str] = {}   # (cls, attr)->kind
+        self.instance_of: Dict[str, str] = {}               # mod var -> class
+        self.attr_instance_of: Dict[Tuple[str, str], str] = {}
+        self.functions: Dict[str, FunctionFacts] = {}
+        self.donating: Dict[str, Tuple[int, ...]] = {}
+        self.jit_functions: List[Tuple[str, int]] = []
+        self.shard_map_bodies: List[Tuple[str, ast.AST]] = []
+        self.collective_uses: List[CollectiveUse] = []
+
+    # -- entry --
+    def build(self) -> ModuleFacts:
+        self._scan_module_level()
+        self._scan_classes_for_locks()
+        for node in self.tree.body:
+            self._walk_scope(node, cls=None, func=None)
+        self._scan_donation_and_shard_map()
+        return ModuleFacts(relpath=self.relpath, lock_defs=self.lock_defs,
+                           functions=self.functions, donating=self.donating,
+                           jit_functions=self.jit_functions,
+                           shard_map_bodies=self.shard_map_bodies,
+                           collective_uses=self.collective_uses,
+                           instance_of=self.instance_of,
+                           attr_instance_of=self.attr_instance_of)
+
+    # -- module-level lock defs + singleton instances --
+    def _scan_module_level(self) -> None:
+        for node in self.tree.body:
+            if not isinstance(node, ast.Assign):
+                continue
+            kind = _is_lock_factory_call(node.value)
+            for t in node.targets:
+                if not isinstance(t, ast.Name):
+                    continue
+                if kind:
+                    lid = f"{self.relpath}::{t.id}"
+                    self.lock_defs[lid] = LockDef(lid, kind, self.relpath,
+                                                  node.lineno)
+                elif isinstance(node.value, ast.Call) and \
+                        isinstance(node.value.func, ast.Name):
+                    self.instance_of[t.id] = node.value.func.id
+
+    def _scan_classes_for_locks(self) -> None:
+        for node in self.tree.body:
+            if not isinstance(node, ast.ClassDef):
+                continue
+            for sub in ast.walk(node):
+                if not isinstance(sub, ast.Assign):
+                    continue
+                kind = _is_lock_factory_call(sub.value)
+                for t in sub.targets:
+                    if not (isinstance(t, ast.Attribute)
+                            and isinstance(t.value, ast.Name)
+                            and t.value.id == "self"):
+                        continue
+                    if kind:
+                        self.class_locks[(node.name, t.attr)] = kind
+                        lid = f"{self.relpath}::{node.name}.{t.attr}"
+                        self.lock_defs[lid] = LockDef(lid, kind, self.relpath,
+                                                      sub.lineno)
+                    elif isinstance(sub.value, ast.Call) and \
+                            isinstance(sub.value.func, ast.Name):
+                        # self.attr = SomeClass(...): instance attribute —
+                        # lets pass 2 resolve self.attr.method() precisely
+                        self.attr_instance_of[(node.name, t.attr)] = \
+                            sub.value.func.id
+
+    # -- lock identity resolution --
+    def resolve_lock_expr(self, expr: ast.AST, cls: Optional[str],
+                          func: Optional[str],
+                          local_locks: Dict[str, str]) -> Optional[str]:
+        if isinstance(expr, ast.Name):
+            lid = f"{self.relpath}::{expr.id}"
+            if lid in self.lock_defs:
+                return lid
+            if expr.id in local_locks:
+                return local_locks[expr.id]
+            if "lock" in expr.id.lower():
+                return lid
+            return None
+        if isinstance(expr, ast.Attribute):
+            base = expr.value
+            if isinstance(base, ast.Name):
+                if base.id == "self" and cls is not None:
+                    if (cls, expr.attr) in self.class_locks or \
+                            "lock" in expr.attr.lower():
+                        return f"{self.relpath}::{cls}.{expr.attr}"
+                    return None
+                inst_cls = self.instance_of.get(base.id)
+                if inst_cls is not None and \
+                        ((inst_cls, expr.attr) in self.class_locks
+                         or "lock" in expr.attr.lower()):
+                    return f"{self.relpath}::{inst_cls}.{expr.attr}"
+                if "lock" in expr.attr.lower():
+                    return f"{self.relpath}::{base.id}.{expr.attr}"
+            elif "lock" in expr.attr.lower():
+                return f"{self.relpath}::?.{expr.attr}"
+        return None
+
+    # -- function bodies: acquisitions + calls with held-lock context --
+    def _walk_scope(self, node: ast.AST, cls: Optional[str],
+                    func: Optional[str]) -> None:
+        if isinstance(node, ast.ClassDef):
+            for child in node.body:
+                self._walk_scope(child, cls=node.name, func=None)
+            return
+        if isinstance(node, (ast.FunctionDef, ast.AsyncFunctionDef)):
+            qual = f"{cls}.{node.name}" if cls else node.name
+            ff = self.functions.setdefault(
+                qual, FunctionFacts(module=self.relpath, qual=qual,
+                                    line=node.lineno, acquires=[], calls=[]))
+            local_locks: Dict[str, str] = {}
+            for child in node.body:
+                self._visit_stmt(child, cls, qual, ff, (), local_locks)
+            return
+        # other module-level statements: nothing to do
+
+    def _visit_stmt(self, node: ast.AST, cls: Optional[str], qual: str,
+                    ff: FunctionFacts, held: Tuple[str, ...],
+                    local_locks: Dict[str, str]) -> None:
+        if isinstance(node, (ast.FunctionDef, ast.AsyncFunctionDef)):
+            # nested def: its body is a separate function scope
+            self._walk_scope(node, cls=cls, func=qual)
+            return
+        if isinstance(node, ast.ClassDef):
+            self._walk_scope(node, cls=node.name, func=None)
+            return
+        if isinstance(node, ast.Assign):
+            kind = _is_lock_factory_call(node.value)
+            if kind:
+                for t in node.targets:
+                    if isinstance(t, ast.Name):
+                        lid = f"{self.relpath}::{qual}.{t.id}"
+                        local_locks[t.id] = lid
+                        self.lock_defs[lid] = LockDef(lid, kind, self.relpath,
+                                                      node.lineno)
+        if isinstance(node, (ast.With, ast.AsyncWith)):
+            inner = held
+            for item in node.items:
+                lid = self.resolve_lock_expr(item.context_expr, cls, qual,
+                                             local_locks)
+                self._visit_expr(item.context_expr, qual, ff, inner)
+                if lid is not None:
+                    ff.acquires.append(Acquire(lid, node.lineno, inner))
+                    inner = inner + (lid,)
+            for child in node.body:
+                self._visit_stmt(child, cls, qual, ff, inner, local_locks)
+            return
+        # generic statement: record calls in expressions, recurse into
+        # compound bodies with unchanged held-set
+        for field in ast.iter_child_nodes(node):
+            if isinstance(field, ast.stmt):
+                self._visit_stmt(field, cls, qual, ff, held, local_locks)
+            else:
+                self._visit_expr(field, qual, ff, held)
+
+    def _visit_expr(self, node: ast.AST, qual: str, ff: FunctionFacts,
+                    held: Tuple[str, ...]) -> None:
+        for sub in ast.walk(node):
+            if isinstance(sub, (ast.FunctionDef, ast.AsyncFunctionDef)):
+                continue
+            if isinstance(sub, ast.Call):
+                f = sub.func
+                if isinstance(f, ast.Attribute):
+                    ff.calls.append(CallSite(f.attr, sub.lineno, held, True,
+                                             _receiver_of(f.value)))
+                elif isinstance(f, ast.Name):
+                    ff.calls.append(CallSite(f.id, sub.lineno, held, False))
+
+    # -- donation wrappers, jit boundaries, shard_map bodies, collectives --
+    def _scan_donation_and_shard_map(self) -> None:
+        from .core import decorator_jit_call, is_jit_expr, jit_call_info
+        defs_by_name = {n.name: n for n in ast.walk(self.tree)
+                        if isinstance(n, (ast.FunctionDef,
+                                          ast.AsyncFunctionDef))}
+        shard_map_nodes: List[ast.AST] = []
+        for node in ast.walk(self.tree):
+            if isinstance(node, ast.Assign):
+                for call in _jit_calls_in(node.value):
+                    donated = _donated_positions(call)
+                    if donated is None:
+                        continue
+                    for t in node.targets:
+                        if isinstance(t, ast.Name):
+                            self.donating[t.id] = donated
+            elif isinstance(node, (ast.FunctionDef, ast.AsyncFunctionDef)):
+                for dec in node.decorator_list:
+                    call = decorator_jit_call(dec)
+                    if call is not None or is_jit_expr(dec):
+                        self.jit_functions.append((node.name, node.lineno))
+                    if call is not None:
+                        donated = _donated_positions(call)
+                        if donated is not None:
+                            self.donating[node.name] = donated
+            if isinstance(node, ast.Call):
+                f = node.func
+                fname = f.attr if isinstance(f, ast.Attribute) else \
+                    f.id if isinstance(f, ast.Name) else ""
+                if fname in _SHARD_MAP_NAMES and node.args:
+                    target = node.args[0]
+                    body = target if isinstance(target, ast.Lambda) else \
+                        defs_by_name.get(target.id) \
+                        if isinstance(target, ast.Name) else None
+                    if body is not None:
+                        label = getattr(body, "name", "<lambda>")
+                        self.shard_map_bodies.append((label, body))
+                        shard_map_nodes.append(body)
+                call = jit_call_info(node)
+                if call is not None and call.args and \
+                        isinstance(call.args[0], ast.Name):
+                    self.jit_functions.append((call.args[0].id, node.lineno))
+        in_sm: Set[int] = set()
+        for _, body in self.shard_map_bodies:
+            for sub in ast.walk(body):
+                in_sm.add(id(sub))
+        for node in ast.walk(self.tree):
+            if not isinstance(node, ast.Call) or \
+                    not isinstance(node.func, ast.Attribute):
+                continue
+            if node.func.attr not in _COLLECTIVES:
+                continue
+            axis = _axis_literal(node)
+            self.collective_uses.append(CollectiveUse(
+                op=node.func.attr, axis=axis, line=node.lineno,
+                in_shard_map=id(node) in in_sm))
+
+
+def _receiver_of(base: ast.AST) -> str:
+    """Encode a method call's receiver expression (see CallSite.receiver)."""
+    if isinstance(base, ast.Name):
+        return "self" if base.id == "self" else base.id
+    if isinstance(base, ast.Attribute) and isinstance(base.value, ast.Name):
+        if base.value.id == "self":
+            return f"self.{base.attr}"
+        return f"{base.value.id}.{base.attr}"
+    return "?"
+
+
+def _axis_literal(call: ast.Call) -> Optional[str]:
+    """The ``axis_name`` argument of a collective call, when it is a string
+    literal (positional or keyword); None for variables/expressions."""
+    cand: Optional[ast.AST] = None
+    for kw in call.keywords:
+        if kw.arg in ("axis_name", "axis"):
+            cand = kw.value
+    if cand is None:
+        pos = 0 if call.func.attr == "axis_index" else 1
+        if len(call.args) > pos:
+            cand = call.args[pos]
+    if isinstance(cand, ast.Constant) and isinstance(cand.value, str):
+        return cand.value
+    return None
+
+
+# ---------------------------------------------------------------------------
+# repo-level assembly
+
+
+def build_module_facts(relpath: str, tree: ast.Module) -> ModuleFacts:
+    return _ModuleFactsBuilder(relpath, tree).build()
+
+
+def mesh_axes(mesh_path: Optional[str] = None) -> Set[str]:
+    """Axis names declared in ``parallel/mesh.py`` (``DATA_AXIS = "data"``
+    style constants), parsed without importing. Falls back to {"data"}."""
+    from .core import _FACT_CACHE, PKG_DIR, _parse_file
+    path = mesh_path or os.path.join(PKG_DIR, "parallel", "mesh.py")
+    key = "mesh_axes:" + path
+    if key in _FACT_CACHE:
+        return _FACT_CACHE[key]
+    out: Set[str] = set()
+    tree = _parse_file(path)
+    if tree is not None:
+        for node in ast.walk(tree):
+            if isinstance(node, ast.Assign) and \
+                    isinstance(node.value, ast.Constant) and \
+                    isinstance(node.value.value, str):
+                for t in node.targets:
+                    if isinstance(t, ast.Name) and t.id.endswith("_AXIS"):
+                        out.add(node.value.value)
+    _FACT_CACHE[key] = out or {"data"}
+    return _FACT_CACHE[key]
+
+
+def build_repo_facts(modules: Sequence[Tuple[str, ast.Module]]) -> RepoFacts:
+    """Pass 1 over every parsed module: (relpath, tree) -> RepoFacts."""
+    mods = {rel: build_module_facts(rel, tree) for rel, tree in modules}
+    return RepoFacts(modules=mods, mesh_axes=set(mesh_axes()))

@@ -1,6 +1,7 @@
 """tpu-lint rule battery. Importing this package registers every rule with
 ``core._REGISTRY``; each module holds one hazard class and documents the
 production incident it guards against (see docs/STATIC_ANALYSIS.md)."""
-from . import (atomic_write, device_errors, dtype_drift, host_sync,  # noqa: F401
+from . import (atomic_write, collectives, compile_budget,  # noqa: F401
+               device_errors, donation, dtype_drift, host_sync, lock_order,
                nonfinite, params, retrace, shared_state, telemetry,
                unsharded_transfer)

@@ -1,0 +1,90 @@
+"""Rule: collective-consistency — psum/all_gather axes vs the declared mesh.
+
+Collectives name the mesh axis they reduce over. The mesh axes this package
+ever creates are declared as constants in ``parallel/mesh.py``
+(``DATA_AXIS = "data"``); a collective whose ``axis_name`` is a string
+literal NOT in that set can never match a live mesh — it fails at trace time
+with an unbound-axis error, but only on the distributed path, which single-
+device CI never executes. This rule catches the typo'd axis on every run.
+
+Non-literal axis names (``gp.axis_name``, ``mesh.axis_names[0]``) are the
+blessed idiom — the axis flows from the mesh itself and cannot diverge — and
+are skipped.
+
+The second check flags host callbacks (``jax.pure_callback``,
+``io_callback``, ``jax.debug.callback`` / ``jax.debug.print``) inside a
+``shard_map`` body (warning): every device in the mesh executes the body, so
+the callback runs once PER SHARD, serializes the collective schedule behind
+a host round-trip, and on multi-host meshes fires on every host. Telemetry
+belongs outside the shard_map boundary (the obs plane is host-side by
+design); a deliberate debug callback suppresses inline.
+"""
+from __future__ import annotations
+
+import ast
+from typing import Optional
+
+from ..core import ModuleContext, Rule, register
+
+_CALLBACKS = {"pure_callback", "io_callback", "debug_callback"}
+
+
+@register
+class CollectiveConsistency(Rule):
+    name = "collective-consistency"
+    severity = "error"
+    description = ("collective axis_name literal not declared in "
+                   "parallel/mesh.py, or a host callback inside a "
+                   "shard_map body")
+    rationale = ("a typo'd axis only fails on the distributed path CI "
+                 "doesn't run; a per-shard host callback serializes the "
+                 "collective schedule behind a host round-trip")
+
+    def check_module(self, ctx: ModuleContext) -> None:
+        if ctx.facts is None or ctx.repo_facts is None:
+            return
+        axes = ctx.repo_facts.mesh_axes
+        for use in ctx.facts.collective_uses:
+            if use.axis is not None and use.axis not in axes:
+                ctx.report(
+                    self, use.line,
+                    f"collective {use.op}(..., axis_name={use.axis!r}) "
+                    f"names an axis not declared in parallel/mesh.py "
+                    f"(known: {', '.join(sorted(axes))}); this fails at "
+                    "trace time on the distributed path only — use the "
+                    "mesh's declared axis constant")
+        for label, body in ctx.facts.shard_map_bodies:
+            self._check_callbacks(ctx, label, body)
+
+    def _check_callbacks(self, ctx: ModuleContext, label: str,
+                         body: ast.AST) -> None:
+        for node in ast.walk(body):
+            if not isinstance(node, ast.Call):
+                continue
+            name = _callback_name(node.func)
+            if name is not None:
+                ctx.report(
+                    self, node,
+                    f"host callback {name} inside the shard_map body "
+                    f"{label!r} runs once per shard and serializes the "
+                    "collective schedule behind a host round-trip; move "
+                    "host-side observation outside the shard_map boundary",
+                    severity="warning")
+
+
+def _callback_name(func: ast.AST) -> Optional[str]:
+    if isinstance(func, ast.Attribute):
+        if func.attr in _CALLBACKS:
+            return func.attr
+        # jax.debug.print / jax.debug.callback
+        if func.attr in ("print", "callback") and \
+                isinstance(func.value, ast.Attribute) and \
+                func.value.attr == "debug":
+            return f"debug.{func.attr}"
+        if func.attr in ("print", "callback") and \
+                isinstance(func.value, ast.Name) and \
+                func.value.id == "debug":
+            return f"debug.{func.attr}"
+    elif isinstance(func, ast.Name) and func.id in _CALLBACKS:
+        return func.id
+    return None

@@ -1,0 +1,163 @@
+"""Rule: compile-budget — jit-lowering counts gated against a committed budget.
+
+ROADMAP's "compile diet" work keeps shaving distinct XLA lowerings off the
+warm path; nothing stops them from creeping back (a per-call ``jax.jit``, an
+accidental shape-specialization, a helper that stopped reusing its wrapper).
+Wall-clock compile time is too noisy to gate on; the NUMBER of distinct
+lowerings for a fixed tiny workload is exact and deterministic.
+
+This dynamic rule runs the warmed entry points (dataset construct, a 3-iter
+binary train, cold + warm predict) in a FRESH subprocess under
+``jax._src.test_util.count_jit_and_pmap_lowerings`` (fresh because an
+in-process measurement inherits whatever the current process already traced)
+and diffs the counts against the committed ``LOWERING_BUDGET.json``:
+
+- an entry point lowering MORE programs than budgeted is an **error** (a
+  compile regression reached the tree);
+- lowering FEWER is a **warning** suggesting ``--update-budget`` so the
+  ratchet only ever tightens;
+- probe/budget drift (an entry missing on either side) is an error.
+
+``python -m lightgbm_tpu.analysis --update-budget`` re-measures and rewrites
+the file. The rule runs under ``--dynamic`` (bench.py's preflight wires it
+in next to the lint gate; ``LGBM_TPU_BENCH_SKIP_LINT=1`` skips both).
+
+This module itself stays JAX-free (the analyzer contract); all JAX work
+happens in the ``budget_probe`` subprocess.
+"""
+from __future__ import annotations
+
+import json
+import os
+import subprocess
+import sys
+from typing import Dict, List, Optional, Tuple
+
+from ..core import REPO_ROOT, Finding, Rule, register
+
+BUDGET_PATH = os.path.join(REPO_ROOT, "LOWERING_BUDGET.json")
+BUDGET_REL = "LOWERING_BUDGET.json"
+PROBE_TIMEOUT_S = 600
+
+
+def load_budget(path: Optional[str] = None) -> Optional[Dict[str, int]]:
+    path = BUDGET_PATH if path is None else path
+    if not os.path.exists(path):
+        return None
+    with open(path) as fh:
+        doc = json.load(fh)
+    return {k: int(v) for k, v in doc.get("entries", {}).items()}
+
+
+def diff_counts(measured: Dict[str, int],
+                budget: Dict[str, int]) -> List[Tuple[str, str]]:
+    """(severity, message) per divergence. Growth and drift are errors;
+    shrinkage is a warning nudging the budget down."""
+    out: List[Tuple[str, str]] = []
+    for name in sorted(set(measured) | set(budget)):
+        m, b = measured.get(name), budget.get(name)
+        if b is None:
+            out.append(("error",
+                        f"entry point {name!r} measured {m} lowering(s) but "
+                        f"has no budget entry — run --update-budget to "
+                        "admit it deliberately"))
+        elif m is None:
+            out.append(("error",
+                        f"budget entry {name!r} was not measured — the "
+                        "probe and the budget drifted; run --update-budget"))
+        elif m > b:
+            out.append(("error",
+                        f"compile-budget regression: {name!r} lowered {m} "
+                        f"program(s), budget is {b} (+{m - b}) — a per-call "
+                        "jit or a new specialization reached the warm path; "
+                        "fix it or deliberately raise the budget with "
+                        "--update-budget"))
+        elif m < b:
+            out.append(("warning",
+                        f"compile diet win: {name!r} lowered {m} "
+                        f"program(s), budget is {b} ({m - b}) — ratchet the "
+                        "budget down with --update-budget"))
+    return out
+
+
+def measure(timeout_s: int = PROBE_TIMEOUT_S) -> Dict[str, int]:
+    """Run the probe in a fresh, canonical subprocess (single CPU device,
+    no inherited lint/telemetry env) and return its counts. Raises
+    RuntimeError with the probe's stderr tail on failure."""
+    env = dict(os.environ)
+    for k in ("LGBMTPU_LINT_ONLY", "LGBMTPU_TELEMETRY", "XLA_FLAGS"):
+        env.pop(k, None)
+    env["JAX_PLATFORMS"] = "cpu"
+    proc = subprocess.run(
+        [sys.executable, "-m", "lightgbm_tpu.analysis.budget_probe"],
+        cwd=REPO_ROOT, env=env, capture_output=True, text=True,
+        timeout=timeout_s)
+    if proc.returncode != 0:
+        tail = (proc.stderr or proc.stdout or "")[-2000:]
+        raise RuntimeError(f"budget probe failed (rc={proc.returncode}): "
+                           f"{tail}")
+    doc = json.loads(proc.stdout.strip().splitlines()[-1])
+    return {k: int(v) for k, v in doc["counts"].items()}
+
+
+def write_budget(measured: Dict[str, int],
+                 path: Optional[str] = None) -> None:
+    path = BUDGET_PATH if path is None else path
+    doc = {
+        "version": 1,
+        "comment": "Distinct jit lowerings per warmed entry point, measured "
+                   "by lightgbm_tpu/analysis/budget_probe.py on a "
+                   "single-device CPU backend. Growth fails tpu-lint's "
+                   "compile-budget rule; regenerate deliberately with "
+                   "`python -m lightgbm_tpu.analysis --update-budget`.",
+        "entries": {k: int(v) for k, v in sorted(measured.items())},
+    }
+    tmp = path + ".tmp"
+    with open(tmp, "w") as fh:  # tpu-lint: disable=non-atomic-artifact-write
+        json.dump(doc, fh, indent=1, sort_keys=True)
+        fh.write("\n")
+    os.replace(tmp, path)
+
+
+def update_budget_cli() -> int:
+    print("measuring lowering counts (fresh CPU subprocess)...", flush=True)
+    try:
+        measured = measure()
+    except (RuntimeError, subprocess.TimeoutExpired, ValueError) as e:
+        print(f"FAIL compile-budget: {e}", file=sys.stderr)
+        return 1
+    old = load_budget() or {}
+    write_budget(measured)
+    for name in sorted(set(measured) | set(old)):
+        o, n = old.get(name, "-"), measured.get(name, "-")
+        mark = "=" if o == n else "->"
+        print(f"  {name:24s} {o} {mark} {n}")
+    print(f"wrote {BUDGET_PATH}")
+    return 0
+
+
+@register
+class CompileBudget(Rule):
+    name = "compile-budget"
+    severity = "error"
+    description = ("jit lowering count of the warmed entry points grew past "
+                   "the committed LOWERING_BUDGET.json")
+    rationale = ("compile-diet wins regress silently — counting distinct "
+                 "lowerings for a fixed workload is exact where wall-clock "
+                 "compile time is noise")
+    kind = "dynamic"
+
+    def run_dynamic(self) -> List[Finding]:
+        budget = load_budget()
+        if budget is None:
+            return [Finding(self.name, BUDGET_REL, 1,
+                            "LOWERING_BUDGET.json is missing — create it "
+                            "with `python -m lightgbm_tpu.analysis "
+                            "--update-budget`", "error")]
+        try:
+            measured = measure()
+        except (RuntimeError, subprocess.TimeoutExpired, ValueError) as e:
+            return [Finding(self.name, BUDGET_REL, 1,
+                            f"budget probe failed: {e}", "error")]
+        return [Finding(self.name, BUDGET_REL, 1, msg, sev)
+                for sev, msg in diff_counts(measured, budget)]

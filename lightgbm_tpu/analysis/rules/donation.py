@@ -1,0 +1,145 @@
+"""Rule: donation-safety — a donated buffer read after the donating call.
+
+``jax.jit(..., donate_argnums=...)`` hands the argument's device buffer to
+XLA for reuse: after the call the original array is INVALID, and touching it
+raises (on TPU/GPU) or — worse under some backends — silently reads freed
+memory. The safe idioms are (a) rebind the result over the donated name
+(``acc = fused(acc, ...)``: the dead name can never be read again) or
+(b) never mention the donated name after the call.
+
+Pass 1 collects every donating wrapper in the module —
+``W = jax.jit(f, donate_argnums=(0,))`` module-level (including the
+``jit(...) if CAN_DONATE else None`` conditional form) and
+``@partial(jax.jit, donate_argnums=...)`` decorated defs. This rule walks
+each call site of a donating wrapper: a plain-Name argument in a donated
+position that is loaded again later in the same function — without being
+rebound at the call statement or in between — is a use-after-donate.
+
+For calls inside a loop the check also wraps around: a read of the donated
+name earlier in the loop body (next iteration's view) counts, unless the
+call statement rebinds it.
+"""
+from __future__ import annotations
+
+import ast
+from typing import Dict, List, Optional, Set, Tuple
+
+from ..core import ModuleContext, Rule, register
+
+
+@register
+class DonationSafety(Rule):
+    name = "donation-safety"
+    severity = "error"
+    description = ("argument donated via donate_argnums is read after the "
+                   "donating call in the same function")
+    rationale = ("a donated buffer is invalidated by XLA at dispatch; "
+                 "reading it later raises on TPU or reads reused memory — "
+                 "rebind the result over the donated name or drop the name")
+
+    def check_module(self, ctx: ModuleContext) -> None:
+        donating = ctx.facts.donating if ctx.facts is not None else {}
+        if not donating:
+            return
+        for fn in ast.walk(ctx.tree):
+            if isinstance(fn, (ast.FunctionDef, ast.AsyncFunctionDef)):
+                self._check_function(ctx, fn, donating)
+
+    def _check_function(self, ctx: ModuleContext, fn: ast.AST,
+                        donating: Dict[str, Tuple[int, ...]]) -> None:
+        for call in ast.walk(fn):
+            if not isinstance(call, ast.Call) or \
+                    not isinstance(call.func, ast.Name):
+                continue
+            positions = donating.get(call.func.id)
+            if positions is None:
+                continue
+            if _innermost_function(ctx, call) is not fn:
+                continue   # a closure's call is checked in the closure's
+                # own scope — execution order vs the outer body is unknown
+            donated = {a.id for i, a in enumerate(call.args)
+                       if i in positions and isinstance(a, ast.Name)}
+            if not donated:
+                continue
+            stmt = _enclosing_stmt(ctx, call)
+            rebound = _stmt_binds(stmt) if stmt is not None else set()
+            for name in sorted(donated - rebound):
+                site = self._use_after_donate(ctx, fn, call, name)
+                if site is not None:
+                    ctx.report(
+                        self, site,
+                        f"donated buffer {name!r} is read after being "
+                        f"donated to {call.func.id}() at line "
+                        f"{call.lineno}; the buffer is invalid past the "
+                        "call — rebind the result over the name "
+                        f"({name} = {call.func.id}(...)) or copy first")
+
+    def _use_after_donate(self, ctx: ModuleContext, fn: ast.AST,
+                          call: ast.Call, name: str) -> Optional[ast.AST]:
+        """First hazardous read of ``name`` after ``call`` (or, inside a
+        loop, anywhere in the loop body), honoring intervening rebinds."""
+        rebind_lines = sorted(
+            n.lineno for n in ast.walk(fn)
+            if isinstance(n, (ast.Assign, ast.AugAssign, ast.AnnAssign))
+            and name in _stmt_binds(n) and n.lineno > call.lineno)
+        next_rebind = rebind_lines[0] if rebind_lines else None
+        in_call = {id(s) for s in ast.walk(call)}
+        loop = _enclosing_loop(ctx, call)
+        for node in ast.walk(fn):
+            if not (isinstance(node, ast.Name) and node.id == name
+                    and isinstance(node.ctx, ast.Load)
+                    and id(node) not in in_call):
+                continue
+            if node.lineno > call.lineno and \
+                    (next_rebind is None or node.lineno < next_rebind):
+                return node
+            if loop is not None and node.lineno <= call.lineno and \
+                    _contains(loop, node):
+                # wrap-around: the next iteration reads a buffer the
+                # previous iteration donated
+                return node
+        return None
+
+
+def _innermost_function(ctx: ModuleContext, node: ast.AST) -> Optional[ast.AST]:
+    for anc in ctx.ancestors(node):
+        if isinstance(anc, (ast.FunctionDef, ast.AsyncFunctionDef, ast.Lambda)):
+            return anc
+    return None
+
+
+def _enclosing_stmt(ctx: ModuleContext, node: ast.AST) -> Optional[ast.stmt]:
+    cur: Optional[ast.AST] = node
+    while cur is not None and not isinstance(cur, ast.stmt):
+        cur = ctx.parents.get(cur)
+    return cur
+
+
+def _enclosing_loop(ctx: ModuleContext, node: ast.AST) -> Optional[ast.AST]:
+    for anc in ctx.ancestors(node):
+        if isinstance(anc, (ast.For, ast.While, ast.AsyncFor)):
+            return anc
+        if isinstance(anc, (ast.FunctionDef, ast.AsyncFunctionDef)):
+            return None
+    return None
+
+
+def _contains(tree: ast.AST, node: ast.AST) -> bool:
+    return any(sub is node for sub in ast.walk(tree))
+
+
+def _stmt_binds(stmt: ast.AST) -> Set[str]:
+    """Names (re)bound by an assignment statement's targets."""
+    out: Set[str] = set()
+    if isinstance(stmt, ast.Assign):
+        targets: List[ast.AST] = list(stmt.targets)
+    elif isinstance(stmt, (ast.AugAssign, ast.AnnAssign)):
+        targets = [stmt.target]
+    else:
+        return out
+    for t in targets:
+        for sub in ast.walk(t):
+            if isinstance(sub, ast.Name) and \
+                    isinstance(sub.ctx, (ast.Store,)):
+                out.add(sub.id)
+    return out

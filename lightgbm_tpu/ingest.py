@@ -265,7 +265,9 @@ def stream_encode_upload(raw, mappers, meta, *, width: int,
                             shard_plan.devices[shard])
                     local0 = g0 - shard * shard_plan.rows_per_shard
                     acc = _set_rows(acc, dev, jnp.int32(local0))
-                    with lock:
+                    # single-writer: only this commit thread ever folds into
+                    # accs; the lock publishes the slot to concurrent readers
+                    with lock:  # tpu-lint: disable=lock-order
                         state["accs"][shard] = acc
                 else:
                     if state["acc"] is None:

@@ -141,8 +141,11 @@ def time_op_in_jit(op, *big, K: int = 6, reps: int = 1):
         return jax.lax.fori_loop(
             0, k, lambda i, acc: acc + op(acc * 0 + 1 + i, *a), x0)
 
-    f1 = jax.jit(_partial(loop, 1))
-    fK = jax.jit(_partial(loop, K))
+    # fresh wrappers per call by design: each timing must include exactly
+    # one compile so (t_K - t_1)/(K - 1) cancels dispatch latency; caching
+    # them would poison the methodology
+    f1 = jax.jit(_partial(loop, 1))  # tpu-lint: disable=retrace-hazard
+    fK = jax.jit(_partial(loop, K))  # tpu-lint: disable=retrace-hazard
     x0 = jnp.zeros((), jnp.float32)
     jax.block_until_ready(f1(x0, *big))
     jax.block_until_ready(fK(x0, *big))

@@ -12,6 +12,23 @@ flags = os.environ.get("XLA_FLAGS", "")
 if "xla_force_host_platform_device_count" not in flags:
     os.environ["XLA_FLAGS"] = (flags + " --xla_force_host_platform_device_count=8").strip()
 
+# lockwatch must patch threading.Lock/RLock BEFORE any product module runs
+# and creates its locks, and importing lightgbm_tpu.analysis.lockwatch the
+# normal way would pull in the full package (and jax) first — so load it by
+# file path, registered under its canonical sys.modules key so later normal
+# imports reuse this instance
+import importlib.util as _ilu
+import sys as _sys
+
+_lw_spec = _ilu.spec_from_file_location(
+    "lightgbm_tpu.analysis.lockwatch",
+    os.path.join(os.path.dirname(os.path.dirname(os.path.abspath(__file__))),
+                 "lightgbm_tpu", "analysis", "lockwatch.py"))
+lockwatch = _ilu.module_from_spec(_lw_spec)
+_sys.modules["lightgbm_tpu.analysis.lockwatch"] = lockwatch
+_lw_spec.loader.exec_module(lockwatch)
+lockwatch.install()
+
 import numpy as np
 import pytest
 

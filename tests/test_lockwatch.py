@@ -1,0 +1,118 @@
+"""Runtime lock-order watchdog (analysis/lockwatch.py): the proxy patch
+installed by conftest, inversion detection on a synthetic deadlock-shaped
+interleaving, reentrant-RLock exemption, and assert_clean semantics.
+
+These tests use a PRIVATE LockWatch instance wired to locally-created
+proxies, so nothing here can contaminate the global WATCH that the
+server/online/obs suites assert clean at module teardown."""
+import threading
+
+import pytest
+
+from lightgbm_tpu.analysis import lockwatch
+
+
+def _pair(watch):
+    """Two watched locks bound to a private watch instance."""
+    a = lockwatch._LockProxy(lockwatch._REAL_LOCK(), "mod.py:10", False)
+    b = lockwatch._LockProxy(lockwatch._REAL_LOCK(), "mod.py:20", False)
+    return _rebind(a, watch), _rebind(b, watch)
+
+
+def _rebind(proxy, watch):
+    """Route a proxy's recording to a private watch (tests only)."""
+    class _Bound:
+        def __init__(self, p):
+            self._p = p
+
+        def __enter__(self):
+            self._p._lock.acquire()
+            watch.note_acquire(self._p._site, self._p._reentrant)
+            return self
+
+        def __exit__(self, *exc):
+            watch.note_release(self._p._site)
+            self._p._lock.release()
+    return _Bound(proxy)
+
+
+def test_conftest_installed_the_patch():
+    """conftest loads lockwatch before jax/product imports; product locks
+    must therefore be proxies while stdlib-made locks pass through."""
+    import lightgbm_tpu.server  # noqa: F401  (package already imported)
+    from lightgbm_tpu.server import ModelRegistry
+    reg = ModelRegistry()
+    assert isinstance(reg._lock, lockwatch._LockProxy), \
+        "product lock was created before lockwatch.install() patched threading"
+
+
+def test_consistent_order_is_clean():
+    w = lockwatch.LockWatch()
+    a, b = _pair(w)
+    for _ in range(3):
+        with a:
+            with b:
+                pass
+    assert w.inversions() == []
+    w.assert_clean()
+
+
+def test_inversion_detected_across_threads():
+    w = lockwatch.LockWatch()
+    a, b = _pair(w)
+
+    with a:
+        with b:
+            pass
+
+    def reversed_order():
+        with b:
+            with a:
+                pass
+
+    t = threading.Thread(target=reversed_order)
+    t.start()
+    t.join()
+
+    inv = w.inversions()
+    assert len(inv) == 1
+    assert "mod.py:10" in inv[0] and "mod.py:20" in inv[0]
+    with pytest.raises(AssertionError, match="inversion"):
+        w.assert_clean("test")
+
+
+def test_rlock_reentry_records_no_self_edge():
+    w = lockwatch.LockWatch()
+    r = lockwatch._LockProxy(lockwatch._REAL_RLOCK(), "mod.py:30", True)
+    rb = _rebind(r, w)
+    with rb:
+        with rb:          # legal RLock re-entry
+            pass
+    assert w.edges() == {}
+
+
+def test_reset_clears_recorded_edges():
+    w = lockwatch.LockWatch()
+    a, b = _pair(w)
+    with a:
+        with b:
+            pass
+    assert w.edges()
+    w.reset()
+    assert w.edges() == {}
+
+
+def test_proxy_delegates_and_reports_locked():
+    p = lockwatch._LockProxy(lockwatch._REAL_LOCK(), "mod.py:40", False)
+    assert p.locked() is False
+    assert p.acquire()
+    assert p.locked() is True
+    p.release()
+    assert "mod.py:40" in repr(p)
+
+
+def test_global_watch_currently_clean():
+    """Whatever the suite has run so far, the REAL lock graph must have no
+    inversions — this is the same assertion the server/online/obs suites
+    make at teardown, checked here as an any-time invariant."""
+    lockwatch.WATCH.assert_clean("tests/test_lockwatch.py")

@@ -28,6 +28,13 @@ from lightgbm_tpu.server import PredictServer, handle_line
 from lightgbm_tpu.utils import faults
 
 RNG = np.random.RandomState(23)
+
+
+@pytest.fixture(scope="module", autouse=True)
+def _lockwatch_zero_inversions():
+    from lightgbm_tpu.analysis import lockwatch
+    yield
+    lockwatch.WATCH.assert_clean("tests/test_obs_plane.py")
 N_FEAT = 6
 
 
@@ -392,7 +399,17 @@ def test_stats_and_protocol_include_slo_latency_age(booster, queries):
     try:
         for n in (1, 4, 8):
             srv.predict(queries[:n])
-        st = srv.stats()
+        # the flusher completes requests BEFORE the SLO/latency bookkeeping
+        # (responses never wait on metrics), so the last flush's observe may
+        # still be in flight when predict() returns — poll for it to land
+        deadline = time.time() + 5.0
+        while time.time() < deadline:
+            st = srv.stats()
+            if (st.get("slo", {}).get("default", {}).get("requests", 0) >= 3
+                    and st.get("latency", {}).get("default", {})
+                                             .get("count", 0) >= 3):
+                break
+            time.sleep(0.01)
         assert st["models"]["default"]["age_s"] >= 0.0
         slo = st["slo"]["default"]
         assert slo["requests"] >= 3 and 0.0 <= slo["attainment"] <= 1.0

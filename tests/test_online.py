@@ -28,6 +28,13 @@ from lightgbm_tpu.online import (OnlineTrainer, last_cycle_stats,
 from lightgbm_tpu.server import PredictServer, handle_line
 from lightgbm_tpu.utils.log import LightGBMError
 
+
+@pytest.fixture(scope="module", autouse=True)
+def _lockwatch_zero_inversions():
+    from lightgbm_tpu.analysis import lockwatch
+    yield
+    lockwatch.WATCH.assert_clean("tests/test_online.py")
+
 RNG = np.random.RandomState(23)
 N_FEAT = 8
 

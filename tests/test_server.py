@@ -22,6 +22,16 @@ RNG = np.random.RandomState(11)
 N_FEAT = 8
 
 
+@pytest.fixture(scope="module", autouse=True)
+def _lockwatch_zero_inversions():
+    """The static lock-order rule says the serve stack's lock graph is a
+    DAG; the runtime watchdog (installed by conftest before any product
+    lock exists) must agree after this suite's real concurrency."""
+    from lightgbm_tpu.analysis import lockwatch
+    yield
+    lockwatch.WATCH.assert_clean("tests/test_server.py")
+
+
 def _train(rounds=6, seed_shift=0.0):
     X = RNG.rand(500, N_FEAT)
     y = (X[:, 0] + X[:, 1] + seed_shift * X[:, 2] > 1).astype(float)
