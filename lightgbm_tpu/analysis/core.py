@@ -32,6 +32,8 @@ Workflow surfaces:
 from __future__ import annotations
 
 import ast
+
+from .astwalk import walk
 import dataclasses
 import io
 import json
@@ -133,7 +135,7 @@ class ModuleContext:
         self.tree = ast.parse(source, filename=relpath)
         self.findings: List[Finding] = []
         self.parents: Dict[ast.AST, ast.AST] = {}
-        for parent in ast.walk(self.tree):
+        for parent in walk(self.tree):
             for child in ast.iter_child_nodes(parent):
                 self.parents[child] = parent
         self.numpy_aliases, self.jnp_aliases, self.jax_aliases = \
@@ -175,7 +177,7 @@ class ModuleContext:
 
     def mentions_device_api(self, node: ast.AST) -> bool:
         """Subtree references jax/jnp (device work happens near here)."""
-        for sub in ast.walk(node):
+        for sub in walk(node):
             if isinstance(sub, ast.Name) and \
                     sub.id in (self.jnp_aliases | self.jax_aliases):
                 return True
@@ -196,7 +198,7 @@ class ModuleContext:
 
 def _import_aliases(tree: ast.Module) -> Tuple[Set[str], Set[str], Set[str]]:
     numpy_a, jnp_a, jax_a = set(), set(), set()
-    for node in ast.walk(tree):
+    for node in walk(tree):
         if isinstance(node, ast.Import):
             for a in node.names:
                 name = a.asname or a.name.split(".")[0]
@@ -297,12 +299,12 @@ def static_names_from_call(call: Optional[ast.Call],
         params = [p.arg for p in a.posonlyargs + a.args]
     for kw in call.keywords:
         if kw.arg == "static_argnames":
-            for sub in ast.walk(kw.value):
+            for sub in walk(kw.value):
                 if isinstance(sub, ast.Constant) and isinstance(sub.value,
                                                                 str):
                     out.add(sub.value)
         elif kw.arg == "static_argnums":
-            for sub in ast.walk(kw.value):
+            for sub in walk(kw.value):
                 if isinstance(sub, ast.Constant) and \
                         isinstance(sub.value, int) and \
                         0 <= sub.value < len(params):
@@ -333,7 +335,7 @@ def registered_params(config_path: Optional[str] = None) -> Set[str]:
     names: Set[str] = set()
     tree = _parse_file(path)
     if tree is not None:
-        for node in ast.walk(tree):
+        for node in walk(tree):
             if isinstance(node, (ast.Assign, ast.AnnAssign)):
                 targets = node.targets if isinstance(node, ast.Assign) \
                     else [node.target]
@@ -346,7 +348,7 @@ def registered_params(config_path: Optional[str] = None) -> Set[str]:
                                                                   str):
                         names.add(k.value)
                     if isinstance(v, ast.Tuple) and len(v.elts) == 2:
-                        for alias in ast.walk(v.elts[1]):
+                        for alias in walk(v.elts[1]):
                             if isinstance(alias, ast.Constant) and \
                                     isinstance(alias.value, str):
                                 names.add(alias.value)
@@ -364,14 +366,14 @@ def nonfinite_policies(config_path: Optional[str] = None) -> Set[str]:
     out: Set[str] = set()
     tree = _parse_file(path)
     if tree is not None:
-        for node in ast.walk(tree):
+        for node in walk(tree):
             if not isinstance(node, ast.Compare):
                 continue
             left = node.left
             if isinstance(left, ast.Attribute) and \
                     left.attr == "nonfinite_policy":
                 for comp in node.comparators:
-                    for sub in ast.walk(comp):
+                    for sub in walk(comp):
                         if isinstance(sub, ast.Constant) and \
                                 isinstance(sub.value, str):
                             out.add(sub.value)
@@ -395,7 +397,7 @@ def event_schemas(events_path: Optional[str] = None) \
                 if isinstance(k, ast.Constant) and isinstance(k.value, str)}
 
     if tree is not None:
-        for node in ast.walk(tree):
+        for node in walk(tree):
             if isinstance(node, (ast.Assign, ast.AnnAssign)):
                 targets = node.targets if isinstance(node, ast.Assign) \
                     else [node.target]

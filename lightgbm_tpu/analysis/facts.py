@@ -26,6 +26,8 @@ gets the same node in the repo-wide graph no matter which module acquires it.
 from __future__ import annotations
 
 import ast
+
+from .astwalk import walk
 import dataclasses
 import os
 from typing import Dict, List, Optional, Sequence, Set, Tuple
@@ -139,7 +141,7 @@ def _donated_positions(call: ast.Call) -> Optional[Tuple[int, ...]]:
     ``donate_argnums`` keyword (int or tuple literal)."""
     for kw in call.keywords:
         if kw.arg == "donate_argnums":
-            idx = [s.value for s in ast.walk(kw.value)
+            idx = [s.value for s in walk(kw.value)
                    if isinstance(s, ast.Constant) and isinstance(s.value, int)]
             return tuple(sorted(set(idx)))
     return None
@@ -149,7 +151,7 @@ def _jit_calls_in(node: ast.AST):
     """Yield every ``jax.jit(...)`` / ``partial(jax.jit, ...)`` Call in the
     expression (unwraps IfExp arms, e.g. ``jit(...) if CAN else None``)."""
     from .core import jit_call_info
-    for sub in ast.walk(node):
+    for sub in walk(node):
         call = jit_call_info(sub)
         if call is not None:
             yield call
@@ -208,7 +210,7 @@ class _ModuleFactsBuilder(ast.NodeVisitor):
         for node in self.tree.body:
             if not isinstance(node, ast.ClassDef):
                 continue
-            for sub in ast.walk(node):
+            for sub in walk(node):
                 if not isinstance(sub, ast.Assign):
                     continue
                 kind = _is_lock_factory_call(sub.value)
@@ -320,7 +322,7 @@ class _ModuleFactsBuilder(ast.NodeVisitor):
 
     def _visit_expr(self, node: ast.AST, qual: str, ff: FunctionFacts,
                     held: Tuple[str, ...]) -> None:
-        for sub in ast.walk(node):
+        for sub in walk(node):
             if isinstance(sub, (ast.FunctionDef, ast.AsyncFunctionDef)):
                 continue
             if isinstance(sub, ast.Call):
@@ -334,11 +336,11 @@ class _ModuleFactsBuilder(ast.NodeVisitor):
     # -- donation wrappers, jit boundaries, shard_map bodies, collectives --
     def _scan_donation_and_shard_map(self) -> None:
         from .core import decorator_jit_call, is_jit_expr, jit_call_info
-        defs_by_name = {n.name: n for n in ast.walk(self.tree)
+        defs_by_name = {n.name: n for n in walk(self.tree)
                         if isinstance(n, (ast.FunctionDef,
                                           ast.AsyncFunctionDef))}
         shard_map_nodes: List[ast.AST] = []
-        for node in ast.walk(self.tree):
+        for node in walk(self.tree):
             if isinstance(node, ast.Assign):
                 for call in _jit_calls_in(node.value):
                     donated = _donated_positions(call)
@@ -375,9 +377,9 @@ class _ModuleFactsBuilder(ast.NodeVisitor):
                     self.jit_functions.append((call.args[0].id, node.lineno))
         in_sm: Set[int] = set()
         for _, body in self.shard_map_bodies:
-            for sub in ast.walk(body):
+            for sub in walk(body):
                 in_sm.add(id(sub))
-        for node in ast.walk(self.tree):
+        for node in walk(self.tree):
             if not isinstance(node, ast.Call) or \
                     not isinstance(node.func, ast.Attribute):
                 continue
@@ -435,7 +437,7 @@ def mesh_axes(mesh_path: Optional[str] = None) -> Set[str]:
     out: Set[str] = set()
     tree = _parse_file(path)
     if tree is not None:
-        for node in ast.walk(tree):
+        for node in walk(tree):
             if isinstance(node, ast.Assign) and \
                     isinstance(node.value, ast.Constant) and \
                     isinstance(node.value.value, str):

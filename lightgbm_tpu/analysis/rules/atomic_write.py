@@ -19,6 +19,8 @@ from __future__ import annotations
 
 import ast
 
+from ..astwalk import walk
+
 from ..core import ModuleContext, Rule, register
 
 # modules that implement the atomic/virtual write layer itself
@@ -40,7 +42,7 @@ class NonAtomicArtifactWrite(Rule):
     def check_module(self, ctx: ModuleContext) -> None:
         if ctx.relpath.endswith(_EXEMPT_SUFFIXES):
             return
-        for node in ast.walk(ctx.tree):
+        for node in walk(ctx.tree):
             if not isinstance(node, ast.Call):
                 continue
             f = node.func

@@ -22,6 +22,8 @@ design); a deliberate debug callback suppresses inline.
 from __future__ import annotations
 
 import ast
+
+from ..astwalk import walk
 from typing import Optional
 
 from ..core import ModuleContext, Rule, register
@@ -58,7 +60,7 @@ class CollectiveConsistency(Rule):
 
     def _check_callbacks(self, ctx: ModuleContext, label: str,
                          body: ast.AST) -> None:
-        for node in ast.walk(body):
+        for node in walk(body):
             if not isinstance(node, ast.Call):
                 continue
             name = _callback_name(node.func)

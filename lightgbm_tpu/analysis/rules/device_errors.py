@@ -29,6 +29,8 @@ swallow what they like.
 from __future__ import annotations
 
 import ast
+
+from ..astwalk import walk
 from typing import List, Optional
 
 from ..core import ModuleContext, Rule, register
@@ -70,14 +72,14 @@ def _caught_names(h: ast.ExceptHandler) -> List[str]:
 
 def _uses_name(node: ast.AST, name: str) -> bool:
     return any(isinstance(n, ast.Name) and n.id == name
-               for n in ast.walk(node))
+               for n in walk(node))
 
 
 def _handler_is_ok(h: ast.ExceptHandler) -> bool:
     """True when the handler re-raises, retries, emits, or hands the bound
     exception to a non-logging callee."""
     exc_name = h.name
-    for node in ast.walk(h):
+    for node in walk(h):
         if isinstance(node, ast.Raise):
             return True
         if not isinstance(node, ast.Call):
@@ -110,12 +112,12 @@ class SwallowedDeviceError(Rule):
         rp = ctx.relpath
         if "lightgbm_tpu/" not in rp or "lightgbm_tpu/analysis/" in rp:
             return
-        for node in ast.walk(ctx.tree):
+        for node in walk(ctx.tree):
             if not isinstance(node, ast.Try):
                 continue
             has_device_site = any(
                 isinstance(n, ast.Call) and _call_name(n) in _DEVICE_SITES
-                for b in node.body for n in ast.walk(b))
+                for b in node.body for n in walk(b))
             if not has_device_site:
                 continue
             for h in node.handlers:

@@ -22,6 +22,8 @@ call statement rebinds it.
 from __future__ import annotations
 
 import ast
+
+from ..astwalk import walk
 from typing import Dict, List, Optional, Set, Tuple
 
 from ..core import ModuleContext, Rule, register
@@ -41,13 +43,13 @@ class DonationSafety(Rule):
         donating = ctx.facts.donating if ctx.facts is not None else {}
         if not donating:
             return
-        for fn in ast.walk(ctx.tree):
+        for fn in walk(ctx.tree):
             if isinstance(fn, (ast.FunctionDef, ast.AsyncFunctionDef)):
                 self._check_function(ctx, fn, donating)
 
     def _check_function(self, ctx: ModuleContext, fn: ast.AST,
                         donating: Dict[str, Tuple[int, ...]]) -> None:
-        for call in ast.walk(fn):
+        for call in walk(fn):
             if not isinstance(call, ast.Call) or \
                     not isinstance(call.func, ast.Name):
                 continue
@@ -79,13 +81,13 @@ class DonationSafety(Rule):
         """First hazardous read of ``name`` after ``call`` (or, inside a
         loop, anywhere in the loop body), honoring intervening rebinds."""
         rebind_lines = sorted(
-            n.lineno for n in ast.walk(fn)
+            n.lineno for n in walk(fn)
             if isinstance(n, (ast.Assign, ast.AugAssign, ast.AnnAssign))
             and name in _stmt_binds(n) and n.lineno > call.lineno)
         next_rebind = rebind_lines[0] if rebind_lines else None
-        in_call = {id(s) for s in ast.walk(call)}
+        in_call = {id(s) for s in walk(call)}
         loop = _enclosing_loop(ctx, call)
-        for node in ast.walk(fn):
+        for node in walk(fn):
             if not (isinstance(node, ast.Name) and node.id == name
                     and isinstance(node.ctx, ast.Load)
                     and id(node) not in in_call):
@@ -125,7 +127,7 @@ def _enclosing_loop(ctx: ModuleContext, node: ast.AST) -> Optional[ast.AST]:
 
 
 def _contains(tree: ast.AST, node: ast.AST) -> bool:
-    return any(sub is node for sub in ast.walk(tree))
+    return any(sub is node for sub in walk(tree))
 
 
 def _stmt_binds(stmt: ast.AST) -> Set[str]:
@@ -138,7 +140,7 @@ def _stmt_binds(stmt: ast.AST) -> Set[str]:
     else:
         return out
     for t in targets:
-        for sub in ast.walk(t):
+        for sub in walk(t):
             if isinstance(sub, ast.Name) and \
                     isinstance(sub.ctx, (ast.Store,)):
                 out.add(sub.id)

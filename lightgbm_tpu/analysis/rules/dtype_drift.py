@@ -26,6 +26,8 @@ flagged (the precision is transient and the device dtype is explicit).
 from __future__ import annotations
 
 import ast
+
+from ..astwalk import walk
 from typing import Dict, Set
 
 from ..core import ModuleContext, Rule, register
@@ -46,7 +48,7 @@ class DtypeDrift(Rule):
     def check_module(self, ctx: ModuleContext) -> None:
         if not ctx.jnp_aliases and not ctx.jax_aliases:
             return   # module never touches the device API
-        for fn in ast.walk(ctx.tree):
+        for fn in walk(ctx.tree):
             if isinstance(fn, (ast.FunctionDef, ast.AsyncFunctionDef)):
                 self._check_function(ctx, fn)
 
@@ -55,7 +57,7 @@ class DtypeDrift(Rule):
             return
         dtypeless_np_vars: Dict[str, int] = {}
         reported: Set[int] = set()
-        for node in ast.walk(fn):
+        for node in walk(fn):
             if not isinstance(node, ast.Call):
                 continue
             # explicit float64 construction near device code

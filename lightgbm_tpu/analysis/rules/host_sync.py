@@ -29,6 +29,8 @@ queue itself, with a timeout.
 from __future__ import annotations
 
 import ast
+
+from ..astwalk import walk
 from typing import List, Optional, Set, Tuple
 
 from ..core import (ModuleContext, Rule, decorator_jit_call, is_jit_decorated,
@@ -67,6 +69,10 @@ SCHED_LOOPS: Set[Tuple[str, str]] = {
     # interruptible), never a bare sleep — a sleep there delays shutdown
     # by up to a full flush interval
     ("lightgbm_tpu/obs/__init__.py", "_flush_loop"),
+    # the fleet health prober: a bare sleep or un-timed join there delays
+    # both the next probe round and shutdown by a full probe interval;
+    # all waiting belongs on the stop event
+    ("lightgbm_tpu/fleet/replica.py", "_probe_loop"),
 }
 
 
@@ -83,7 +89,7 @@ class HostSyncInJit(Rule):
         jitted = _collect_jitted(ctx)
         for fn, static_names in jitted:
             self._check_jit_body(ctx, fn, static_names)
-        for node in ast.walk(ctx.tree):
+        for node in walk(ctx.tree):
             if isinstance(node, (ast.FunctionDef, ast.AsyncFunctionDef)):
                 if (ctx.relpath, node.name) in HOT_LOOPS:
                     self._check_hot_loop(ctx, node)
@@ -95,7 +101,7 @@ class HostSyncInJit(Rule):
                         static_names: Set[str]) -> None:
         body = fn.body if isinstance(fn.body, list) else [fn.body]
         for stmt in body:
-            for node in ast.walk(stmt):
+            for node in walk(stmt):
                 if not isinstance(node, ast.Call):
                     continue
                 f = node.func
@@ -134,10 +140,10 @@ class HostSyncInJit(Rule):
 
     # -- designated host hot loops --
     def _check_hot_loop(self, ctx: ModuleContext, fn: ast.AST) -> None:
-        for loop in ast.walk(fn):
+        for loop in walk(fn):
             if not isinstance(loop, (ast.For, ast.While)):
                 continue
-            for node in ast.walk(loop):
+            for node in walk(loop):
                 if not isinstance(node, ast.Call):
                     continue
                 f = node.func
@@ -157,10 +163,10 @@ class HostSyncInJit(Rule):
         flag time.sleep (the queue should do the waiting), ``.join()`` with
         no timeout (unbounded stall of every queued request), and ``.get()``
         with neither timeout nor args (blocks forever, deaf to shutdown)."""
-        for loop in ast.walk(fn):
+        for loop in walk(fn):
             if not isinstance(loop, (ast.For, ast.While)):
                 continue
-            for node in ast.walk(loop):
+            for node in walk(loop):
                 if not isinstance(node, ast.Call):
                     continue
                 f = node.func
@@ -189,7 +195,7 @@ class HostSyncInJit(Rule):
 def _is_static_metadata(node: ast.AST) -> bool:
     """``x.shape[0]`` / ``x.ndim`` / ``x.dtype`` / ``x.size`` are trace-time
     Python values even on tracers — casting them is not a sync."""
-    for sub in ast.walk(node):
+    for sub in walk(node):
         if isinstance(sub, ast.Attribute) and \
                 sub.attr in ("shape", "ndim", "dtype", "size"):
             return True
@@ -208,10 +214,10 @@ def _collect_jitted(ctx: ModuleContext) -> List[Tuple[ast.AST, Set[str]]]:
     via ``jax.jit(f)``, and jitted lambdas."""
     out: List[Tuple[ast.AST, Set[str]]] = []
     defs_by_name = {}
-    for node in ast.walk(ctx.tree):
+    for node in walk(ctx.tree):
         if isinstance(node, (ast.FunctionDef, ast.AsyncFunctionDef)):
             defs_by_name.setdefault(node.name, []).append(node)
-    for node in ast.walk(ctx.tree):
+    for node in walk(ctx.tree):
         if isinstance(node, (ast.FunctionDef, ast.AsyncFunctionDef)) and \
                 is_jit_decorated(node):
             call = next((decorator_jit_call(d) for d in node.decorator_list

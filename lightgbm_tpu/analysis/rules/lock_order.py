@@ -39,13 +39,15 @@ is not flagged.
 from __future__ import annotations
 
 import ast
+
+from ..astwalk import walk
 from typing import Dict, List, Optional, Set, Tuple
 
 from ..core import ModuleContext, Rule, register
 
 _SCOPE_FILES = ("lightgbm_tpu/serving.py", "lightgbm_tpu/server.py",
                 "lightgbm_tpu/ingest.py", "lightgbm_tpu/online.py")
-_SCOPE_DIRS = ("lightgbm_tpu/obs/",)
+_SCOPE_DIRS = ("lightgbm_tpu/obs/", "lightgbm_tpu/fleet/")
 
 
 def _in_scope(relpath: str) -> bool:
@@ -68,7 +70,7 @@ class LockOrder(Rule):
     def check_module(self, ctx: ModuleContext) -> None:
         if not _in_scope(ctx.relpath) or ctx.facts is None:
             return
-        for node in ast.walk(ctx.tree):
+        for node in walk(ctx.tree):
             if isinstance(node, (ast.FunctionDef, ast.AsyncFunctionDef)):
                 self._check_then_act(ctx, node)
 
@@ -76,7 +78,7 @@ class LockOrder(Rule):
         builder = _rebuilder(ctx)
         withs: Dict[str, List[ast.With]] = {}
         cls = _enclosing_class(ctx, fn)
-        for node in ast.walk(fn):
+        for node in walk(fn):
             if not isinstance(node, (ast.With, ast.AsyncWith)):
                 continue
             if _innermost_function(ctx, node) is not fn:
@@ -212,7 +214,7 @@ def _enclosing_class(ctx: ModuleContext, fn: ast.AST) -> Optional[str]:
 
 def _names_stored(block: ast.AST) -> Set[str]:
     out: Set[str] = set()
-    for node in ast.walk(block):
+    for node in walk(block):
         if isinstance(node, (ast.Assign, ast.AugAssign, ast.AnnAssign)):
             targets = node.targets if isinstance(node, ast.Assign) \
                 else [node.target]
@@ -223,7 +225,7 @@ def _names_stored(block: ast.AST) -> Set[str]:
 
 
 def _names_loaded(block: ast.AST) -> Set[str]:
-    return {n.id for n in ast.walk(block)
+    return {n.id for n in walk(block)
             if isinstance(n, ast.Name) and isinstance(n.ctx, ast.Load)}
 
 

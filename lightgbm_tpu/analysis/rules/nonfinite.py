@@ -21,6 +21,8 @@ config time). Two complementary guards:
 from __future__ import annotations
 
 import ast
+
+from ..astwalk import walk
 from typing import List
 
 from ..core import (Finding, ModuleContext, Rule, nonfinite_policies,
@@ -40,7 +42,7 @@ class NonfinitePolicyLiteral(Rule):
 
     def check_module(self, ctx: ModuleContext) -> None:
         legal = nonfinite_policies()
-        for node in ast.walk(ctx.tree):
+        for node in walk(ctx.tree):
             # {"nonfinite_policy": "<lit>"} in any dict literal
             if isinstance(node, ast.Dict):
                 for k, v in zip(node.keys, node.values):
@@ -55,7 +57,7 @@ class NonfinitePolicyLiteral(Rule):
             # <expr>.nonfinite_policy == "<lit>"  /  in ("<lit>", ...)
             elif isinstance(node, ast.Compare) and _mentions_key(node.left):
                 for comp in node.comparators:
-                    for sub in ast.walk(comp):
+                    for sub in walk(comp):
                         if isinstance(sub, ast.Constant):
                             self._check_value(ctx, sub, legal)
             # f(nonfinite_policy="<lit>")
@@ -81,7 +83,7 @@ def _is_key_target(t: ast.AST) -> bool:
 
 
 def _mentions_key(node: ast.AST) -> bool:
-    for sub in ast.walk(node):
+    for sub in walk(node):
         if isinstance(sub, ast.Attribute) and sub.attr == _KEY:
             return True
         if isinstance(sub, ast.Constant) and sub.value == _KEY:

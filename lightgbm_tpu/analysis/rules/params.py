@@ -18,6 +18,8 @@ is NOT assumed to be a Config — efb.py uses ``conf`` for a conflict matrix.)
 from __future__ import annotations
 
 import ast
+
+from ..astwalk import walk
 from typing import Set
 
 from ..core import ModuleContext, Rule, register, registered_params
@@ -42,7 +44,7 @@ class UnregisteredParam(Rule):
         known = registered_params()
         if not known:
             return   # config.py unavailable (fixture runs): stay silent
-        for node in ast.walk(ctx.tree):
+        for node in walk(ctx.tree):
             # params["key"] / params.get("key")
             if isinstance(node, ast.Subscript) and \
                     _is_params_dict(node.value):
@@ -64,7 +66,7 @@ class UnregisteredParam(Rule):
                             key.value not in known:
                         self._flag(ctx, node, key.value,
                                    via=f.attr + "()")
-        for fn in ast.walk(ctx.tree):
+        for fn in walk(ctx.tree):
             if isinstance(fn, (ast.FunctionDef, ast.AsyncFunctionDef)):
                 self._check_config_vars(ctx, fn, known)
 
@@ -73,7 +75,7 @@ class UnregisteredParam(Rule):
         conf_vars = _config_vars(fn)
         if not conf_vars:
             return
-        for node in ast.walk(fn):
+        for node in walk(fn):
             if isinstance(node, ast.Attribute) and \
                     isinstance(node.value, ast.Name) and \
                     node.value.id in conf_vars:
@@ -118,7 +120,7 @@ def _config_vars(fn: ast.AST) -> Set[str]:
             out.add(p.arg)
         elif isinstance(ann, ast.Constant) and ann.value == "Config":
             out.add(p.arg)
-    for node in ast.walk(fn):
+    for node in walk(fn):
         if not isinstance(node, ast.Assign) or \
                 not isinstance(node.value, ast.Call):
             continue

@@ -24,6 +24,8 @@ Three sub-patterns, all observed (and paid for) in this codebase's history
 from __future__ import annotations
 
 import ast
+
+from ..astwalk import walk
 from typing import Optional, Set
 
 from ..core import (ModuleContext, Rule, decorator_jit_call, is_jit_expr,
@@ -41,7 +43,7 @@ class RetraceHazard(Rule):
                  "in the cache")
 
     def check_module(self, ctx: ModuleContext) -> None:
-        for node in ast.walk(ctx.tree):
+        for node in walk(ctx.tree):
             if isinstance(node, (ast.FunctionDef, ast.AsyncFunctionDef)):
                 self._check_decorators(ctx, node)
         self._check_jit_calls(ctx)
@@ -66,7 +68,7 @@ class RetraceHazard(Rule):
         declared: Set[str] = set()
         for kw in call.keywords:
             if kw.arg == "static_argnames":
-                for sub in ast.walk(kw.value):
+                for sub in walk(kw.value):
                     if isinstance(sub, ast.Constant) and \
                             isinstance(sub.value, str):
                         declared.add(sub.value)
@@ -93,13 +95,13 @@ class RetraceHazard(Rule):
         call => fresh trace-cache key => retrace)."""
         fdefs = (ast.FunctionDef, ast.AsyncFunctionDef)
         deco_nodes: Set[int] = set()       # ids of decorator-subtree nodes
-        for fn in ast.walk(ctx.tree):
+        for fn in walk(ctx.tree):
             if not isinstance(fn, fdefs):
                 continue
             jit_deco = any(is_jit_expr(d) or jit_call_info(d) is not None
                            for d in fn.decorator_list)
             for dec in fn.decorator_list:
-                for sub in ast.walk(dec):
+                for sub in walk(dec):
                     deco_nodes.add(id(sub))
             if jit_deco and any(isinstance(anc, fdefs)
                                 for anc in ctx.ancestors(fn)):
@@ -107,7 +109,7 @@ class RetraceHazard(Rule):
                            f"jit-decorated def {fn.name}() nested inside a "
                            "function is re-created (and retraced) on every "
                            "outer call; hoist it or cache the wrapper")
-        for node in ast.walk(ctx.tree):
+        for node in walk(ctx.tree):
             call = jit_call_info(node)
             if call is None or id(call) in deco_nodes:
                 continue
@@ -121,16 +123,16 @@ class RetraceHazard(Rule):
     def _check_traced_branches(self, ctx: ModuleContext) -> None:
         # jitted defs: decorated only (wrapped-by-name bodies are usually
         # shared with non-jit callers, where host branching is legal)
-        for fn in ast.walk(ctx.tree):
+        for fn in walk(ctx.tree):
             if not isinstance(fn, (ast.FunctionDef, ast.AsyncFunctionDef)):
                 continue
             if not any(is_jit_expr(d) or jit_call_info(d) is not None
                        for d in fn.decorator_list):
                 continue
-            for node in ast.walk(fn):
+            for node in walk(fn):
                 if not isinstance(node, (ast.If, ast.While)):
                     continue
-                for sub in ast.walk(node.test):
+                for sub in walk(node.test):
                     if isinstance(sub, ast.Call) and (
                             ctx.is_jnp_attr(sub.func)
                             or _is_lax_attr(ctx, sub.func)):

@@ -28,16 +28,18 @@ comment saying who guarantees single-threadedness.
 from __future__ import annotations
 
 import ast
+
+from ..astwalk import walk
 from typing import Set
 
 from ..core import ModuleContext, Rule, register, root_name
 
 # exact file paths / directory prefixes that are deliberately multi-threaded:
-# the serving engine + microbatch scheduler, the obs sinks, and the chunked
-# ingest pipeline
+# the serving engine + microbatch scheduler, the obs sinks, the chunked
+# ingest pipeline, and the serving fleet (balancer/admission/rollout)
 _SCOPE_FILES = ("lightgbm_tpu/serving.py", "lightgbm_tpu/server.py",
                 "lightgbm_tpu/ingest.py", "lightgbm_tpu/online.py")
-_SCOPE_DIRS = ("lightgbm_tpu/obs/",)
+_SCOPE_DIRS = ("lightgbm_tpu/obs/", "lightgbm_tpu/fleet/")
 _MUTATING_METHODS = {"append", "extend", "add", "update", "setdefault",
                      "pop", "popitem", "clear", "remove", "insert",
                      "discard", "appendleft"}
@@ -60,7 +62,7 @@ class UnlockedSharedState(Rule):
                 or ctx.relpath.startswith("<")):   # fixtures stay in scope
             return
         shared = _module_level_mutables(ctx.tree)
-        for fn in ast.walk(ctx.tree):
+        for fn in walk(ctx.tree):
             if isinstance(fn, (ast.FunctionDef, ast.AsyncFunctionDef)):
                 self._check_function(ctx, fn, shared)
 
@@ -68,10 +70,10 @@ class UnlockedSharedState(Rule):
                         shared: Set[str]) -> None:
         globals_written: Set[str] = set()
         for node in fn.body:
-            for sub in ast.walk(node):
+            for sub in walk(node):
                 if isinstance(sub, ast.Global):
                     globals_written.update(sub.names)
-        for node in ast.walk(fn):
+        for node in walk(fn):
             if isinstance(node, (ast.FunctionDef, ast.AsyncFunctionDef)) and \
                     node is not fn:
                 continue   # nested defs are visited on their own
@@ -138,7 +140,7 @@ def _under_lock(ctx: ModuleContext, node: ast.AST) -> bool:
         if not isinstance(anc, (ast.With, ast.AsyncWith)):
             continue
         for item in anc.items:
-            for sub in ast.walk(item.context_expr):
+            for sub in walk(item.context_expr):
                 name = sub.id if isinstance(sub, ast.Name) else \
                     sub.attr if isinstance(sub, ast.Attribute) else ""
                 if "lock" in name.lower():

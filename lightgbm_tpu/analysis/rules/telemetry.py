@@ -28,6 +28,8 @@ from __future__ import annotations
 
 import ast
 
+from ..astwalk import walk
+
 from ..core import ModuleContext, Rule, event_schemas, register
 
 _SKIP_PREFIXES = ("lightgbm_tpu/obs/events.py",
@@ -53,7 +55,7 @@ class TelemetrySchema(Rule):
         schemas = event_schemas()
         if not schemas:
             return   # obs/events.py unavailable: stay silent
-        for node in ast.walk(ctx.tree):
+        for node in walk(ctx.tree):
             if isinstance(node, ast.Call) and _is_emit_call(node):
                 self._check_site(ctx, node, schemas)
 

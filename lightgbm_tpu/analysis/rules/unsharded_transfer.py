@@ -22,6 +22,8 @@ from __future__ import annotations
 
 import ast
 
+from ..astwalk import walk
+
 from ..core import ModuleContext, Rule, register
 
 # modules that own mesh/shard placement: a transfer here must say where
@@ -49,7 +51,7 @@ class UnshardedTransfer(Rule):
         if not (rp.endswith(_SCOPED_SUFFIXES)
                 or any(d in rp for d in _SCOPED_DIRS)):
             return
-        for node in ast.walk(ctx.tree):
+        for node in walk(ctx.tree):
             if not isinstance(node, ast.Call):
                 continue
             f = node.func

@@ -232,17 +232,32 @@ def run_serve(conf: Config, params: Dict) -> None:
     Protocol (one line per request):
       ``v1,v2,...``       feature row -> ``<version>\\t<score>``
       ``!publish <path>`` atomic hot-swap to a new model version
-      ``!stats``          one-line JSON (scheduler + per-model stats)
+      ``!canary <path> [fraction] [shadow|canary]`` start a rollout
+      ``!promote`` / ``!rollback``   manual rollout transitions
+      ``!stats`` / ``!fleet_stats``  one-line JSON
       ``!quit``           shut down
+
+    With ``fleet_replicas > 1`` the single server is replaced by a
+    :class:`~.fleet.service.FleetServer` — N replicas behind the
+    least-outstanding balancer, same protocol.
     """
     if not conf.input_model:
         log.fatal("No model file: set input_model=<file>")
-    from .server import PredictServer, serve_stdio, serve_tcp
-    server = PredictServer(conf, model=conf.input_model)
-    log.info(f"Published {conf.input_model} as version 1; serving "
-             f"(window={conf.serve_batch_window_us}us, "
-             f"queue_max={conf.serve_queue_max}, "
-             f"max_batch_rows={conf.serve_max_batch_rows})")
+    from .server import serve_stdio, serve_tcp
+    if conf.fleet_replicas > 1:
+        from .fleet.service import FleetServer
+        server = FleetServer(conf, model=conf.input_model)
+        log.info(f"Published {conf.input_model} to {conf.fleet_replicas} "
+                 f"{conf.fleet_mode} replicas; serving "
+                 f"(window={conf.serve_batch_window_us}us, "
+                 f"queue_max={conf.serve_queue_max})")
+    else:
+        from .server import PredictServer
+        server = PredictServer(conf, model=conf.input_model)
+        log.info(f"Published {conf.input_model} as version 1; serving "
+                 f"(window={conf.serve_batch_window_us}us, "
+                 f"queue_max={conf.serve_queue_max}, "
+                 f"max_batch_rows={conf.serve_max_batch_rows})")
     flush_owner = obs.start_periodic_flush(conf.metrics_flush_secs)
     try:
         if conf.serve_port > 0:

@@ -228,6 +228,50 @@ def server_stats_json(server) -> str:
     return json.dumps(server.stats(), sort_keys=True)
 
 
+def server_canary(server, model_path: str, fraction: float,
+                  shadow: int) -> int:
+    """Start a canary/shadow rollout of ``model_path`` against the live
+    model: canary routes ``fraction`` of traffic to the candidate, shadow
+    duplicates it with zero user exposure. Auto-promotes after the
+    drift-free window, auto-rolls-back on PSI/KS divergence. Returns the
+    candidate version, -1 on failure."""
+    try:
+        ro = server.ensure_rollout()
+        return int(ro.start(model_path,
+                            fraction=fraction if fraction > 0 else None,
+                            shadow=bool(shadow)))
+    except Exception:
+        return -1
+
+
+def server_promote(server) -> int:
+    """Promote the active canary now (its warmed engine is re-homed as the
+    live version, no rebuild). Returns the new live version, -1 if no
+    canary is active."""
+    try:
+        return int(server.ensure_rollout().promote())
+    except Exception:
+        return -1
+
+
+def server_rollback(server) -> int:
+    """Roll the active canary back now: the candidate drains and is freed,
+    the incumbent keeps serving. Returns the incumbent version, -1 if no
+    canary is active."""
+    try:
+        return int(server.ensure_rollout().rollback())
+    except Exception:
+        return -1
+
+
+def server_fleet_stats_json(server) -> str:
+    """One-line JSON of the fleet/rollout plane: replica health + routing
+    counters (FleetServer), admission-control states, rollout state machine
+    + comparator PSI/KS."""
+    import json
+    return json.dumps(server.fleet_stats(), sort_keys=True)
+
+
 def server_close(server) -> int:
     """Drain queued requests, stop the scheduler thread."""
     server.close()

@@ -223,6 +223,56 @@ _PARAMS: Dict[str, Tuple[Any, Tuple[str, ...]]] = {
     # task=serve transport: 0 = stdio line protocol, >0 = threaded TCP
     # server on this port
     "serve_port": (0, ()),
+    # flush pacing: minimum microseconds between coalesced flush dispatches
+    # per scheduler (0 = unpaced). This is the per-replica capacity model —
+    # each replica serves at most serve_max_batch_rows per interval, so
+    # fleet capacity scales with replica count
+    "serve_flush_interval_us": (0, ("flush_interval_us",)),
+    # ---- serving fleet (task=serve; see lightgbm_tpu/fleet/) ----
+    # number of serving replicas behind the least-outstanding balancer
+    # (1 = plain single PredictServer, no fleet layer)
+    "fleet_replicas": (1, ("num_replicas", "replicas")),
+    # replica placement: inproc = per-device engine replicas in this process
+    # (multi-chip hosts get one replica per chip) | process = SO_REUSEPORT
+    # worker processes sharing one port (CPU scale-out)
+    "fleet_mode": ("inproc", ("fleet_placement",)),
+    # shared artifact store root every replica reads published model text
+    # from (empty = direct in-memory publish fan-out)
+    "fleet_store": ("", ("artifact_store",)),
+    # replica health-probe interval, seconds (0 = probing off)
+    "fleet_health_s": (2.0, ("replica_health_s",)),
+    # fixed SO_REUSEPORT port for process-mode workers (0 = pick free)
+    "fleet_worker_port": (0, ()),
+    # ---- SLO admission control (fleet/admission.py) ----
+    # admission control off/on: per-model admit/degrade/shed states driven
+    # by the SLO tracker's error-budget burn rate (needs serve_slo_ms > 0
+    # to have any effect; without an SLO everything is admitted)
+    "serve_admission": (True, ("admission_control",)),
+    # burn rate at/above which a model degrades to smaller flush buckets
+    "admission_burn_degrade": (1.5, ()),
+    # burn rate at/above which requests are shed at ingress
+    "admission_burn_shed": (3.0, ()),
+    # coalesced-flush row cap while a model is degraded
+    "serve_degraded_batch_rows": (8, ()),
+    # ---- canary/shadow rollout (fleet/rollout.py) ----
+    # traffic fraction routed to (canary) or duplicated onto (shadow) a
+    # candidate version; also the default for the !canary command and the
+    # auto-canary gate for online-trainer publishes (0 = rollouts manual)
+    "canary_fraction": (0.0, ("canary_pct",)),
+    # drift-free seconds after which a candidate auto-promotes
+    "canary_window_s": (30.0, ("canary_window",)),
+    # PSI at/above which a candidate auto-rolls-back (predict distribution
+    # vs the incumbent; <0.1 stable, 0.1-0.25 drifting, >0.25 act)
+    "canary_psi_max": (0.25, ("psi_threshold",)),
+    # KS statistic threshold for auto-rollback (0 = KS not used)
+    "canary_ks_max": (0.0, ("ks_threshold",)),
+    # minimum per-side comparator samples before any auto transition
+    "canary_min_samples": (200, ()),
+    # shadow mode: candidate gets duplicated traffic, responses compared
+    # but never returned (zero user exposure)
+    "canary_shadow": (False, ("shadow_mode",)),
+    # rolling score-window size per comparator side
+    "canary_cmp_window": (512, ()),
     # ---- continuous training (task=online; see lightgbm_tpu/online.py) ----
     # refit trigger: once this many fresh rows are buffered, append them to
     # the Dataset, refit/continue training, and publish the new version
@@ -412,6 +462,37 @@ class Config:
             log.fatal("serve_max_batch_rows must be >= 1")
         if not 0 <= self.serve_port <= 65535:
             log.fatal(f"serve_port must be in [0, 65535], got {self.serve_port}")
+        if self.serve_flush_interval_us < 0:
+            log.fatal("serve_flush_interval_us must be >= 0 (0 = unpaced)")
+        if self.fleet_replicas < 1:
+            log.fatal("fleet_replicas must be >= 1")
+        if self.fleet_mode not in ("inproc", "process"):
+            log.fatal(f"fleet_mode must be inproc|process, "
+                      f"got {self.fleet_mode!r}")
+        if self.fleet_health_s < 0:
+            log.fatal("fleet_health_s must be >= 0 (0 = probing off)")
+        if not 0 <= self.fleet_worker_port <= 65535:
+            log.fatal(f"fleet_worker_port must be in [0, 65535], "
+                      f"got {self.fleet_worker_port}")
+        if not 0.0 < self.admission_burn_degrade <= self.admission_burn_shed:
+            log.fatal("need 0 < admission_burn_degrade <= admission_burn_shed"
+                      f", got {self.admission_burn_degrade} / "
+                      f"{self.admission_burn_shed}")
+        if self.serve_degraded_batch_rows < 1:
+            log.fatal("serve_degraded_batch_rows must be >= 1")
+        if not 0.0 <= self.canary_fraction <= 1.0:
+            log.fatal(f"canary_fraction must be in [0, 1], "
+                      f"got {self.canary_fraction}")
+        if self.canary_window_s <= 0:
+            log.fatal("canary_window_s must be > 0")
+        if self.canary_psi_max <= 0:
+            log.fatal("canary_psi_max must be > 0")
+        if self.canary_ks_max < 0:
+            log.fatal("canary_ks_max must be >= 0 (0 = KS not used)")
+        if self.canary_min_samples < 1:
+            log.fatal("canary_min_samples must be >= 1")
+        if self.canary_cmp_window < 2:
+            log.fatal("canary_cmp_window must be >= 2")
         if self.online_refit_rows < 1:
             log.fatal("online_refit_rows must be >= 1")
         if self.online_drift_metric_delta < 0:

@@ -71,6 +71,35 @@ EVENT_SCHEMAS: Dict[str, Tuple[Dict[str, Any], Dict[str, Any]]] = {
                      {"served_rows": int, "drain_s": _NUM}),
     # bounded staging queue was full: one request shed (ServeOverload)
     "serve_shed": ({"queued": int, "limit": int}, {"model": str}),
+    # ---- serving fleet / rollout (lightgbm_tpu/fleet/) ----
+    # a canary/shadow rollout started: the candidate version is published
+    # under "<model>@canary" and the comparator begins watching
+    "canary_start": ({"model": str, "version": int, "mode": str,
+                      "fraction": _NUM},
+                     {"incumbent_version": int}),
+    # the candidate was promoted to the live version (drift-free window
+    # elapsed, or manual/!promote); its warmed engine is re-homed, not
+    # rebuilt — clean_s is how long the comparator stayed drift-free
+    "canary_promote": ({"model": str, "version": int, "reason": str},
+                       {"psi": _NUM, "ks": _NUM, "samples": int,
+                        "clean_s": _NUM}),
+    # the candidate was rolled back (PSI/KS divergence, manual, or
+    # superseded by a newer candidate); the incumbent keeps serving and the
+    # candidate's engine drains through the registry refcount
+    "canary_rollback": ({"model": str, "version": int, "reason": str},
+                        {"psi": _NUM, "ks": _NUM, "samples": int}),
+    # a fleet replica's health probe flipped (routed around when unhealthy)
+    "replica_health": ({"replica": str, "healthy": bool},
+                       {"replicas": int, "error": str}),
+    # SLO admission control changed a model's state (admit/degrade/shed)
+    # off the error-budget burn rate
+    "admission_state": ({"model": str, "state": str},
+                        {"burn_rate": _NUM, "attainment": _NUM}),
+    # one request shed at ingress by admission control (budget exhausted)
+    "admission_shed": ({"model": str}, {"burn_rate": _NUM}),
+    # one artifact published to every replica in the fleet
+    "fleet_publish": ({"model": str, "version": int, "replicas": int},
+                      {"duration_s": _NUM}),
     # one chunk made it through the three-stage ingest pipeline
     # (ingest.py): per-stage durations + queue depth observed at commit
     "ingest_chunk": ({"chunk": int, "rows": int},

@@ -45,6 +45,7 @@ from .basic import Booster, Dataset
 from .config import canonical_name, params_to_config
 from .metrics import create_metrics, default_metric_for_objective
 from .utils import log
+from .utils.log import LightGBMError
 
 # last completed refit cycle (bench + test introspection); written under
 # _STATS_LOCK only — trainer threads and bench readers race otherwise
@@ -193,6 +194,19 @@ class OnlineTrainer:
 
     def _publish(self, booster: Booster) -> int:
         if self.server is not None:
+            # with canary_fraction > 0 refit outputs enter through the
+            # rollout gate (fleet/rollout.py) instead of hot-swapping into
+            # live traffic: the comparator judges them against the incumbent
+            # and promotes/rolls back on its own. The very first publish
+            # (version 0 — nothing to compare against) goes direct.
+            if self.conf.canary_fraction > 0 and self.version > 0 and \
+                    hasattr(self.server, "ensure_rollout"):
+                try:
+                    return int(self.server.ensure_rollout(self.name)
+                               .submit_candidate(booster))
+                except LightGBMError as e:
+                    log.warning(f"canary publish unavailable ({e}); "
+                                "publishing direct")
             return int(self.server.publish(booster, name=self.name))
         if self.registry is not None:
             return int(self.registry.publish(self.name, booster).version)

@@ -79,10 +79,10 @@ class _Request:
     flush's span breakdown and the sampled trace exemplars, so a response
     can be correlated with its queue/bin/dispatch/readback timings."""
     __slots__ = ("x", "n", "model", "key", "enq_t", "out", "version",
-                 "exc", "trace_id", "_done")
+                 "exc", "trace_id", "on_done", "_done")
 
     def __init__(self, x: np.ndarray, model: str, raw_score: bool,
-                 pred_leaf: bool):
+                 pred_leaf: bool, on_done=None):
         self.x = x
         self.n = int(x.shape[0])
         self.model = model
@@ -92,16 +92,33 @@ class _Request:
         self.version = -1
         self.exc: Optional[BaseException] = None
         self.trace_id: Optional[str] = None
+        # completion tap, set BEFORE enqueue (submit_async param, never
+        # attached after submit) so there is no set-after-done race; runs on
+        # the scheduler thread inside the flush, i.e. while the serving
+        # version still holds its in-flight refcount
+        self.on_done = on_done
         self._done = threading.Event()
 
     def _finish(self, out: np.ndarray, version: int) -> None:
         self.out = out
         self.version = version
         self._done.set()
+        self._notify()
 
     def _fail(self, exc: BaseException) -> None:
         self.exc = exc
         self._done.set()
+        self._notify()
+
+    def _notify(self) -> None:
+        cb = self.on_done
+        if cb is None:
+            return
+        try:
+            cb(self)
+        except Exception as e:
+            log.warning(f"request on_done callback failed "
+                        f"({type(e).__name__}: {e})")
 
     def done(self) -> bool:
         return self._done.is_set()
@@ -133,6 +150,10 @@ class ServedModel:
         self.retired = False
         self.retired_t = 0.0
         self.published_t = time.time()   # wall clock: model-age freshness
+        # False when the engine was handed to another entry (canary promote
+        # re-homes a warmed engine instead of rebuilding): retire-at-drain
+        # still runs, but must not free device tables it no longer owns
+        self.owns_engine = True
 
 
 class ModelRegistry:
@@ -144,28 +165,41 @@ class ModelRegistry:
     + refcount bump, so a publish never blocks traffic for longer than a
     dict assignment."""
 
-    def __init__(self):
+    def __init__(self, device=None):
         self._models: Dict[str, ServedModel] = {}
         self._lock = threading.Lock()
+        # optional placement: fleet replicas on multi-chip hosts pin each
+        # registry's engines to one device so replicas predict concurrently
+        self.device = device
 
-    def publish(self, name: str, booster, warmup_sizes=(1,),
-                pred_leaf_warmup: bool = False) -> ServedModel:
+    def publish(self, name: str, booster=None, warmup_sizes=(1,),
+                pred_leaf_warmup: bool = False,
+                engine: Optional[PredictEngine] = None) -> ServedModel:
         """Build + warm an engine for ``booster`` and atomically make it the
-        current version of ``name``. Returns the new ServedModel."""
+        current version of ``name``. Returns the new ServedModel.
+
+        Passing ``engine`` instead of ``booster`` re-homes an already-built,
+        already-warmed engine as the next version (canary promote: the
+        candidate's engine becomes live with zero rebuild/re-warm — the
+        caller must clear ``owns_engine`` on the entry it came from)."""
         t0 = time.perf_counter()
-        trees = booster._ensure_host_trees()
-        k = max(booster.num_model_per_iteration(), 1)
-        engine = PredictEngine(trees, booster.num_feature(), k,
-                               booster._avg_output(),
-                               objective=booster._objective_for_predict(),
-                               upload_reason="publish")
-        if warmup_sizes:
-            engine.warmup(sizes=warmup_sizes,
-                          n_features=booster.num_feature())
-            if pred_leaf_warmup:
+        if engine is None:
+            if booster is None:
+                raise ValueError("publish needs a booster or an engine")
+            trees = booster._ensure_host_trees()
+            k = max(booster.num_model_per_iteration(), 1)
+            engine = PredictEngine(trees, booster.num_feature(), k,
+                                   booster._avg_output(),
+                                   objective=booster._objective_for_predict(),
+                                   upload_reason="publish",
+                                   device=self.device)
+            if warmup_sizes:
                 engine.warmup(sizes=warmup_sizes,
-                              n_features=booster.num_feature(),
-                              pred_leaf=True)
+                              n_features=booster.num_feature())
+                if pred_leaf_warmup:
+                    engine.warmup(sizes=warmup_sizes,
+                                  n_features=booster.num_feature(),
+                                  pred_leaf=True)
         with self._lock:
             old = self._models.get(name)
             version = old.version + 1 if old is not None else 1
@@ -211,10 +245,26 @@ class ModelRegistry:
         if free_now:
             self._free(sm)
 
+    def unpublish(self, name: str) -> None:
+        """Retire ``name`` entirely (canary rollback / shadow drop): the
+        entry disappears from routing immediately, its device tables are
+        freed only when the last in-flight flush on it drains — a rollback
+        can never yank an engine out from under a request."""
+        with self._lock:
+            sm = self._models.pop(name, None)
+            if sm is None:
+                return
+            sm.retired = True
+            sm.retired_t = time.perf_counter()
+            free_now = sm.inflight == 0
+        if free_now:
+            self._free(sm)
+
     def _free(self, sm: ServedModel) -> None:
         """Drop a retired version's device tables (after drain)."""
         drain_s = time.perf_counter() - sm.retired_t if sm.retired_t else 0.0
-        sm.engine.release()
+        if sm.owns_engine:
+            sm.engine.release()
         obs.emit("serve_retire", model=sm.name, version=sm.version,
                  served_rows=int(sm.served_rows), drain_s=drain_s)
 
@@ -230,6 +280,27 @@ class ModelRegistry:
                     for name, sm in self._models.items()}
 
 
+def _split_requests(reqs: List["_Request"],
+                    cap: Optional[int]) -> List[List["_Request"]]:
+    """Greedy-pack requests into chunks of at most ``cap`` rows (one flush
+    group each); a single oversized request stays its own chunk. cap=None
+    means no split."""
+    if cap is None:
+        return [reqs]
+    chunks: List[List[_Request]] = []
+    cur: List[_Request] = []
+    rows = 0
+    for r in reqs:
+        if cur and rows + r.n > cap:
+            chunks.append(cur)
+            cur, rows = [], 0
+        cur.append(r)
+        rows += r.n
+    if cur:
+        chunks.append(cur)
+    return chunks
+
+
 class MicroBatcher:
     """Request-coalescing scheduler in front of a :class:`ModelRegistry`.
 
@@ -242,7 +313,8 @@ class MicroBatcher:
     def __init__(self, registry: ModelRegistry, batch_window_us: int = 200,
                  queue_max: int = 8192, max_batch_rows: int = 1024,
                  start: bool = True, trace: bool = False,
-                 trace_sample: int = 16):
+                 trace_sample: int = 16, flush_interval_us: int = 0,
+                 admission=None):
         if queue_max < 1:
             raise ValueError("serve_queue_max must be >= 1")
         if max_batch_rows < 1:
@@ -252,6 +324,15 @@ class MicroBatcher:
         self._max_rows = int(max_batch_rows)
         self._trace = bool(trace)
         self._trace_sample = max(1, int(trace_sample))
+        # flush pacing: minimum time between flush dispatches (0 = off).
+        # This is the per-replica capacity model — one scheduler dispatches
+        # at most max_batch_rows every flush_interval, so a fleet's capacity
+        # scales with its replica count instead of with queue depth
+        self._flush_min_s = max(int(flush_interval_us), 0) * 1e-6
+        self._next_flush_t = 0.0
+        # optional SLO admission controller (fleet.admission): consulted at
+        # ingress (shed) and at flush grouping (degraded batch cap)
+        self._admission = admission
         self._q: "queue.Queue[_Request]" = queue.Queue(maxsize=int(queue_max))
         self._stop = threading.Event()
         # host staging reused across flushes: (bucket, F) -> f64 features,
@@ -260,8 +341,9 @@ class MicroBatcher:
         self._staging_x: Dict[Tuple[int, int], np.ndarray] = {}
         self._staging_bins: Dict[Tuple[int, int], np.ndarray] = {}
         self.stats = {"requests": 0, "rows": 0, "flushes": 0,
-                      "flushed_rows": 0, "shed": 0, "errors": 0,
-                      "max_queue_depth": 0, "fast_path": 0}
+                      "flushed_rows": 0, "shed": 0, "admission_shed": 0,
+                      "errors": 0, "max_queue_depth": 0, "fast_path": 0,
+                      "paced_flushes": 0, "canary_fallback": 0}
         self._stats_lock = threading.Lock()
         self._thread: Optional[threading.Thread] = None
         if start:
@@ -270,11 +352,22 @@ class MicroBatcher:
     # ---- client side ----
 
     def submit_async(self, x, model: str = "default", raw_score: bool = False,
-                     pred_leaf: bool = False) -> _Request:
+                     pred_leaf: bool = False, on_done=None) -> _Request:
         """Enqueue one request; returns a future-like :class:`_Request`.
-        Sheds with :class:`ServeOverload` when the bounded queue is full."""
+        Sheds with :class:`ServeOverload` when the bounded queue is full, or
+        earlier when the SLO admission controller says the error budget is
+        burning too fast (``on_done`` is invoked on the scheduler thread
+        when the request completes, success or failure)."""
         if self._stop.is_set():
             raise RuntimeError("server is shut down")
+        adm = self._admission
+        if adm is not None and adm.decide(model) == "shed":
+            with self._stats_lock:
+                self.stats["admission_shed"] += 1
+            burn = adm.note_shed(model)
+            raise ServeOverload(
+                f"SLO error budget exhausted for {model!r} "
+                f"(burn rate {burn:.2f}); request shed — back off")
         x = np.asarray(x, dtype=np.float64)
         if x.ndim == 1:
             x = x[None, :]
@@ -285,7 +378,7 @@ class MicroBatcher:
             raise ValueError(
                 f"request of {x.shape[0]} rows exceeds serve_max_batch_rows="
                 f"{self._max_rows}; use Booster.predict for bulk batches")
-        req = _Request(x, model, raw_score, pred_leaf)
+        req = _Request(x, model, raw_score, pred_leaf, on_done=on_done)
         if self._trace:
             req.trace_id = tracing.mint_trace_id()
         try:
@@ -388,6 +481,31 @@ class MicroBatcher:
                             break
                     staged.append(nxt)
                     rows += nxt.n
+            if self._flush_min_s > 0.0:
+                # flush pacing: hold this dispatch until the interval since
+                # the previous one has elapsed, scooping any rows that arrive
+                # meanwhile (up to the batch cap). All waits are bounded and
+                # interruptible — queue timeout or the stop event, never a
+                # bare sleep (the scheduler-loop discipline tpu-lint checks)
+                paced = False
+                while not self._stop.is_set():
+                    left = self._next_flush_t - time.perf_counter()
+                    if left <= 0.0:
+                        break
+                    paced = True
+                    if rows < self._max_rows:
+                        try:
+                            nxt = q.get(timeout=left)
+                        except queue.Empty:
+                            continue
+                        staged.append(nxt)
+                        rows += nxt.n
+                    else:
+                        self._stop.wait(left)
+                self._next_flush_t = time.perf_counter() + self._flush_min_s
+                if paced:
+                    with self._stats_lock:
+                        self.stats["paced_flushes"] += 1
             self._flush(staged)
         # shutdown: drain or fail whatever is still queued
         leftovers: List[_Request] = []
@@ -405,27 +523,45 @@ class MicroBatcher:
 
     def _flush(self, staged: List[_Request]) -> None:
         """Serve one coalesced batch: group by (model, options), run each
-        group through its model's engine, scatter responses."""
+        group through its model's engine, scatter responses. A model in the
+        admission controller's *degrade* state gets its groups split at the
+        degraded batch cap — smaller buckets, shorter dispatches, lower
+        per-request latency while the SLO budget recovers."""
         groups: Dict[Tuple[str, Tuple[bool, bool]], List[_Request]] = {}
         for r in staged:
             groups.setdefault((r.model, r.key), []).append(r)
+        adm = self._admission
         for (model, key), reqs in groups.items():
-            try:
-                sm = self.registry.acquire(model)
-            except KeyError as e:
-                for r in reqs:
-                    r._fail(e)
-                continue
-            n = sum(r.n for r in reqs)
-            try:
-                self._flush_group(sm, key, reqs, n)
-            except Exception as e:
-                with self._stats_lock:
-                    self.stats["errors"] += 1
-                for r in reqs:
-                    r._fail(e)
-            finally:
-                self.registry.release(sm, rows=n)
+            cap = adm.batch_cap(model) if adm is not None else None
+            for chunk in _split_requests(reqs, cap):
+                try:
+                    sm = self.registry.acquire(model)
+                except KeyError as e:
+                    # a request staged for "<base>@<shadow>" can lose the
+                    # race with a rollback that unpublishes the shadow name
+                    # before the flush; serve it from the base entry — a
+                    # rollback must never surface as a client error
+                    base, sep, _ = model.partition("@")
+                    try:
+                        if not sep:
+                            raise e
+                        sm = self.registry.acquire(base)
+                    except KeyError:
+                        for r in chunk:
+                            r._fail(e)
+                        continue
+                    with self._stats_lock:
+                        self.stats["canary_fallback"] += len(chunk)
+                n = sum(r.n for r in chunk)
+                try:
+                    self._flush_group(sm, key, chunk, n)
+                except Exception as e:
+                    with self._stats_lock:
+                        self.stats["errors"] += 1
+                    for r in chunk:
+                        r._fail(e)
+                finally:
+                    self.registry.release(sm, rows=n)
 
     def _flush_group(self, sm: ServedModel, key: Tuple[bool, bool],
                      reqs: List[_Request], n: int) -> None:
@@ -566,6 +702,10 @@ class PredictServer:
             else params_to_config(params)
         self.conf = conf
         self.registry = ModelRegistry()
+        # SLO admission control (local import: fleet depends on this module
+        # for MicroBatcher/ModelRegistry, so the dependency must stay lazy)
+        from .fleet.admission import AdmissionController
+        self.admission = AdmissionController.from_config(conf)
         self.batcher = MicroBatcher(
             self.registry,
             batch_window_us=conf.serve_batch_window_us,
@@ -573,8 +713,11 @@ class PredictServer:
             max_batch_rows=conf.serve_max_batch_rows,
             start=start,
             trace=conf.serve_trace,
-            trace_sample=conf.serve_trace_sample)
+            trace_sample=conf.serve_trace_sample,
+            flush_interval_us=conf.serve_flush_interval_us,
+            admission=self.admission)
         self.online = None   # OnlineTrainer, via attach_online
+        self.rollout = None  # RolloutManager, via ensure_rollout
         slo.TRACKER.configure(slo_ms=conf.serve_slo_ms,
                               target=conf.serve_slo_target,
                               window=conf.serve_slo_window)
@@ -613,13 +756,36 @@ class PredictServer:
                                    warmup_sizes=self._warmup_sizes())
         return sm.version
 
+    def ensure_rollout(self, name: str = "default"):
+        """The server's RolloutManager (canary/shadow deployment), created
+        on first use. Once created, :meth:`submit`/:meth:`predict` route
+        through it whenever a rollout is active."""
+        if self.rollout is None:
+            from .fleet.rollout import RolloutManager, ServerBackend
+            self.rollout = RolloutManager(ServerBackend(self), self.conf,
+                                          name=name)
+        return self.rollout
+
     def predict(self, x, model: str = "default", raw_score: bool = False,
                 pred_leaf: bool = False,
                 timeout: Optional[float] = None) -> np.ndarray:
-        return self.batcher.submit(x, model=model, raw_score=raw_score,
-                                   pred_leaf=pred_leaf, timeout=timeout)
+        return self.submit(x, model=model, raw_score=raw_score,
+                           pred_leaf=pred_leaf).result(timeout)
+
+    def predict_versioned(self, x, model: str = "default",
+                          timeout: Optional[float] = None
+                          ) -> Tuple[np.ndarray, int]:
+        """Predict + the version that actually served it — read off the
+        request itself, so the answer is race-free across concurrent
+        hot-swaps (and reflects canary routing when a rollout is live)."""
+        req = self.submit(x, model=model)
+        out = req.result(timeout)
+        return out, req.version
 
     def submit(self, x, **kw) -> _Request:
+        ro = self.rollout
+        if ro is not None and ro.active:
+            return ro.submit(x, **kw)
         return self.batcher.submit_async(x, **kw)
 
     def _statusz(self) -> Dict:
@@ -629,6 +795,10 @@ class PredictServer:
         s = slo.TRACKER.snapshot()
         if s:
             out["slo"] = s
+        if self.admission is not None:
+            out["admission"] = self.admission.snapshot()
+        if self.rollout is not None:
+            out["rollout"] = self.rollout.statusz()
         return out
 
     def _collect_metrics(self, reg) -> None:
@@ -668,9 +838,25 @@ class PredictServer:
         lat = self._latency_summary()
         if lat:
             out["latency"] = lat
+        if self.admission is not None:
+            out["admission"] = self.admission.snapshot()
+        if self.rollout is not None:
+            out["rollout"] = self.rollout.snapshot()
+        return out
+
+    def fleet_stats(self) -> Dict:
+        """Fleet-shaped stats for a single server (the ``!fleet_stats``
+        protocol answer when no ReplicaPool is in front)."""
+        out = {"mode": "single", "replicas": 1,
+               "scheduler": self.batcher.snapshot()}
+        if self.admission is not None:
+            out["admission"] = self.admission.snapshot()
+        if self.rollout is not None:
+            out["rollout"] = self.rollout.snapshot()
         return out
 
     def close(self, drain: bool = True) -> None:
+        self.rollout = None
         self.batcher.close(drain=drain)
         obs.remove_collector("serving")
         obs_http.remove_status_section("serving")
@@ -686,15 +872,20 @@ class PredictServer:
 #   !publish <path>    hot-swap     ->  "ok version=<n>"
 #   !learn <y>,<v1>,.. labeled row into the attached OnlineTrainer
 #                                   ->  "ok pending=<n>[ version=<v>]"
+#   !canary <path> [fraction] [shadow|canary]
+#                      start a rollout -> "ok version=<n> mode=<m>"
+#   !promote           promote the canary now -> "ok version=<n>"
+#   !rollback          roll the canary back   -> "ok version=<n>"
+#   !fleet_stats       fleet/rollout stats    -> one-line JSON
 #   !stats             stats        ->  one-line JSON
 #   !quit              shut down the server loop
 #
-# The same handler serves the stdio loop (serial; deployment smoke tests)
-# and the threaded TCP loop (each connection is a thread, so concurrent
-# connections genuinely coalesce through the shared scheduler).
+# The same handler serves the stdio loop (serial; deployment smoke tests),
+# the threaded TCP loop (each connection is a thread, so concurrent
+# connections genuinely coalesce through the shared scheduler), and — duck-
+# typed — the fleet facade (fleet/service.py) and fleet worker processes.
 
-def handle_line(server: PredictServer, line: str,
-                model: str = "default") -> Optional[str]:
+def handle_line(server, line: str, model: str = "default") -> Optional[str]:
     """One protocol line -> one response line (None = quit)."""
     line = line.strip()
     if not line:
@@ -732,15 +923,51 @@ def handle_line(server: PredictServer, line: str,
                 return f"error: learn failed: {e}"
             tail = f" version={ver}" if ver else ""
             return f"ok pending={server.online.pending_rows}{tail}"
+        if cmd[0] == "!canary":
+            # "!canary <path> [fraction] [shadow|canary]" — start a rollout
+            args = cmd[1].split() if len(cmd) > 1 else []
+            if not args:
+                return "error: !canary needs a model path"
+            fraction = None
+            shadow = None
+            for tok in args[1:]:
+                if tok in ("shadow", "canary"):
+                    shadow = tok == "shadow"
+                else:
+                    try:
+                        fraction = float(tok)
+                    except ValueError:
+                        return f"error: bad !canary argument {tok!r}"
+            try:
+                ro = server.ensure_rollout(model)
+                v = ro.start(args[0], fraction=fraction, shadow=shadow)
+            except Exception as e:
+                return f"error: canary failed: {e}"
+            return f"ok version={v} mode={ro.state}"
+        if cmd[0] == "!promote":
+            try:
+                v = server.ensure_rollout(model).promote()
+            except Exception as e:
+                return f"error: promote failed: {e}"
+            return f"ok version={v}"
+        if cmd[0] == "!rollback":
+            try:
+                v = server.ensure_rollout(model).rollback()
+            except Exception as e:
+                return f"error: rollback failed: {e}"
+            return f"ok version={v}"
+        if cmd[0] == "!fleet_stats":
+            return json.dumps(server.fleet_stats(), sort_keys=True)
         return f"error: unknown command {cmd[0]}"
     try:
         parts = line.replace(",", " ").split()
         if not parts:
             raise ValueError("no features parsed")
         x = np.array([float(p) for p in parts], dtype=np.float64)
-        out = server.predict(x, model=model)
+        # version comes off the request itself (not a second registry read):
+        # race-free under hot-swap, and honest under canary routing
+        out, ver = server.predict_versioned(x, model=model)
         vals = ",".join("%.17g" % v for v in np.asarray(out).reshape(-1))
-        ver = server.registry.current(model).version
         return f"{ver}\t{vals}"
     except ServeOverload:
         return "error: overloaded"

@@ -85,8 +85,13 @@ class PredictEngine:
 
     def __init__(self, trees, n_features: int, k: int, avg_output: bool,
                  objective=None, chunk_rows: Optional[int] = None,
-                 min_bucket: int = _MIN_BUCKET, upload_reason: str = "new"):
+                 min_bucket: int = _MIN_BUCKET, upload_reason: str = "new",
+                 device=None):
         t0 = time.perf_counter()
+        # optional explicit placement (fleet replicas on multi-chip hosts):
+        # every table upload and per-call bin upload lands on this device;
+        # None keeps the default-device behavior bit-for-bit
+        self.device = device
         self.router = PseudoRouter(trees, n_features)
         self.n_trees = len(trees)
         self.k = max(int(k), 1)
@@ -103,7 +108,7 @@ class PredictEngine:
         dense = self.router.dense_tables()
         if dense is not None:
             self._class_dense = [
-                {kk: jax.device_put(np.asarray(v)[cls::self.k])
+                {kk: jax.device_put(np.asarray(v)[cls::self.k], device)
                  for kk, v in dense.items()}
                 for cls in range(self.k)]
         else:
@@ -130,14 +135,14 @@ class PredictEngine:
     def _walk_tables(self, cls: int) -> Dict[str, jax.Array]:
         if self._class_walk is None:
             self._class_walk = [
-                {kk: jax.device_put(np.asarray(v)[c::self.k])
+                {kk: jax.device_put(np.asarray(v)[c::self.k], self.device)
                  for kk, v in self.router.stack.items()}
                 for c in range(self.k)]
         return self._class_walk[cls]
 
     def _stack_full(self) -> Dict[str, jax.Array]:
         if self._full_stack is None:
-            self._full_stack = {kk: jax.device_put(np.asarray(v))
+            self._full_stack = {kk: jax.device_put(np.asarray(v), self.device)
                                 for kk, v in self.router.stack.items()}
         return self._full_stack
 
@@ -230,10 +235,10 @@ class PredictEngine:
         # armed), symmetric with the ingest.py chunk-transfer site
         faults.fault_point("device_put_oom")
         if trace is None:
-            pbins = jax.device_put(bins)
+            pbins = jax.device_put(bins, self.device)
         else:
             t0 = time.perf_counter()
-            pbins = jax.device_put(bins)
+            pbins = jax.device_put(bins, self.device)
             trace["device_dispatch"] = time.perf_counter() - t0
         if pred_leaf:
             out = P.leaf_bins_ensemble(self._stack_full(), pbins,
@@ -279,7 +284,7 @@ class PredictEngine:
             bins, m = item
             with self._stats_lock:
                 self.stats["chunks"] += 1
-            pbins = jax.device_put(bins)
+            pbins = jax.device_put(bins, self.device)
             if pred_leaf:
                 out = np.asarray(P.leaf_bins_ensemble(
                     self._stack_full(), pbins, self.na_dev,

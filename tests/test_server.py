@@ -370,3 +370,48 @@ def test_capi_server_roundtrip(boosters, queries, tmp_path):
         assert '"version": 2' in stats
     finally:
         assert C.server_close(srv) == 0
+
+
+# ---- every boosting type round-trips the serving path ----
+
+_BOOSTING_PARAMS = {
+    "gbdt": {},
+    "dart": {"drop_rate": 0.5, "max_drop": 3},
+    "goss": {"top_rate": 0.3, "other_rate": 0.2},
+    "rf": {"bagging_freq": 1, "bagging_fraction": 0.7},
+}
+
+
+@pytest.mark.parametrize("boosting", sorted(_BOOSTING_PARAMS))
+def test_boosting_types_round_trip_serving(boosting, queries, tmp_path):
+    """GBDT/DART/GOSS/RF all serve bit-exact through the registry/engine:
+    direct Booster.predict == served predictions (score AND raw_score), for
+    both the in-session Booster and the saved->loaded artifact (DART's
+    rescaled leaf values and RF's average_output must survive the publish
+    path, not just in-session prediction)."""
+    X = np.random.RandomState(5).rand(400, N_FEAT)
+    y = (X[:, 0] + X[:, 1] > 1).astype(float)
+    params = {"objective": "binary", "num_leaves": 15, "verbose": -1,
+              "min_data_in_leaf": 5, "boosting": boosting,
+              **_BOOSTING_PARAMS[boosting]}
+    b = lgb.train(params, lgb.Dataset(X, label=y), num_boost_round=6)
+    want = {False: b.predict(queries), True: b.predict(queries, raw_score=True)}
+    path = str(tmp_path / f"{boosting}.txt")
+    b.save_model(path)
+    loaded = lgb.Booster(model_file=path)
+    for raw in (False, True):
+        assert np.array_equal(loaded.predict(queries, raw_score=raw),
+                              want[raw]), (boosting, "loaded", raw)
+    srv = _mk_server(b)
+    try:
+        for raw in (False, True):
+            assert np.array_equal(srv.predict(queries, raw_score=raw),
+                                  want[raw]), (boosting, "served", raw)
+        assert srv.publish(path) == 2       # loaded-artifact publish path
+        for raw in (False, True):
+            assert np.array_equal(srv.predict(queries, raw_score=raw),
+                                  want[raw]), (boosting, "served-v2", raw)
+        assert np.array_equal(srv.predict(queries[:5], pred_leaf=True),
+                              b.predict(queries[:5], pred_leaf=True)), boosting
+    finally:
+        srv.close()

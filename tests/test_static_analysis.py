@@ -1162,6 +1162,162 @@ def test_check_then_act_trio():
                        rules=["lock-order"]))
 
 
+# ---- fleet rule scopes (PR: serving fleet) ----
+# lightgbm_tpu/fleet/ is the third deliberately multi-threaded subsystem
+# (balancer threads, the health-probe loop, the rollout state machine), so
+# the threading rules extend their scope to it: unlocked-shared-state and
+# lock-order cover the whole fleet/ directory, and the replica health
+# prober joins the scheduler-loop audit (waiting belongs on the stop
+# event, never a bare sleep). Each scope extension gets its own
+# fire / suppressed / clean trio.
+
+FLEET_ROLLOUT_REL = "lightgbm_tpu/fleet/rollout.py"
+FLEET_REPLICA_REL = "lightgbm_tpu/fleet/replica.py"
+FLEET_SERVICE_REL = "lightgbm_tpu/fleet/service.py"
+
+FLEET_SHARED_FIRE = """
+_ROLLOUT_HISTORY = []
+
+def record(event):
+    _ROLLOUT_HISTORY.append(event)
+"""
+
+FLEET_SHARED_SUPPRESSED = """
+_ROLLOUT_HISTORY = []
+
+def record(event):
+    # single writer: only the scheduler thread records transitions
+    _ROLLOUT_HISTORY.append(event)  # tpu-lint: disable=unlocked-shared-state
+"""
+
+FLEET_SHARED_CLEAN = """
+import threading
+
+_ROLLOUT_HISTORY = []
+_lock = threading.Lock()
+
+def record(event):
+    with _lock:
+        _ROLLOUT_HISTORY.append(event)
+"""
+
+
+def test_fleet_shared_state_trio():
+    assert "unlocked-shared-state" in names(
+        analyze_source(FLEET_SHARED_FIRE, relpath=FLEET_ROLLOUT_REL))
+    assert "unlocked-shared-state" not in names(
+        analyze_source(FLEET_SHARED_SUPPRESSED, relpath=FLEET_ROLLOUT_REL))
+    assert "unlocked-shared-state" in names(
+        analyze_source(FLEET_SHARED_SUPPRESSED, relpath=FLEET_ROLLOUT_REL,
+                       keep_suppressed=True))
+    assert "unlocked-shared-state" not in names(
+        analyze_source(FLEET_SHARED_CLEAN, relpath=FLEET_ROLLOUT_REL))
+    # same mutation outside the fleet/ scope is the normal idiom
+    assert "unlocked-shared-state" not in names(
+        analyze_source(FLEET_SHARED_FIRE, relpath="lightgbm_tpu/tree.py"))
+
+
+FLEET_PROBE_FIRE = """
+import time
+
+def _probe_loop(self):
+    while not self._stop.is_set():
+        time.sleep(self._interval)
+        self.check_health()
+"""
+
+FLEET_PROBE_SUPPRESSED = """
+import time
+
+def _probe_loop(self):
+    while not self._stop.is_set():
+        # probe-interval test double: exact wall pause wanted
+        time.sleep(self._interval)  # tpu-lint: disable=host-sync-in-jit
+        self.check_health()
+"""
+
+FLEET_PROBE_CLEAN = """
+def _probe_loop(self):
+    while not self._stop.wait(self._interval):
+        self.check_health()
+"""
+
+
+def test_fleet_probe_loop_trio():
+    fs = analyze_source(FLEET_PROBE_FIRE, relpath=FLEET_REPLICA_REL)
+    assert "host-sync-in-jit" in names(fs)
+    assert any("sleep" in f.message for f in fs)
+    assert "host-sync-in-jit" not in names(
+        analyze_source(FLEET_PROBE_SUPPRESSED, relpath=FLEET_REPLICA_REL))
+    assert "host-sync-in-jit" in names(
+        analyze_source(FLEET_PROBE_SUPPRESSED, relpath=FLEET_REPLICA_REL,
+                       keep_suppressed=True))
+    assert "host-sync-in-jit" not in names(
+        analyze_source(FLEET_PROBE_CLEAN, relpath=FLEET_REPLICA_REL))
+    # only the designated (path, function) pair is audited: the same loop
+    # under a different name, or in a module outside the list, passes
+    src_other_fn = FLEET_PROBE_FIRE.replace("_probe_loop", "_poll_once")
+    assert "host-sync-in-jit" not in names(
+        analyze_source(src_other_fn, relpath=FLEET_REPLICA_REL))
+    assert "host-sync-in-jit" not in names(
+        analyze_source(FLEET_PROBE_FIRE, relpath="lightgbm_tpu/engine.py"))
+
+
+FLEET_LOCK_FIRE = """
+import threading
+
+_POOL_LOCK = threading.Lock()
+_ROLLOUT_LOCK = threading.Lock()
+
+def publish_all(model):
+    with _POOL_LOCK:
+        with _ROLLOUT_LOCK:
+            return model
+
+def promote():
+    with _ROLLOUT_LOCK:
+        with _POOL_LOCK:
+            return 1
+"""
+
+FLEET_LOCK_SUPPRESSED = "# tpu-lint: disable-file=lock-order\n" \
+    + FLEET_LOCK_FIRE
+
+FLEET_LOCK_CLEAN = """
+import threading
+
+_POOL_LOCK = threading.Lock()
+_ROLLOUT_LOCK = threading.Lock()
+
+def publish_all(model):
+    with _POOL_LOCK:
+        with _ROLLOUT_LOCK:
+            return model
+
+def promote():
+    with _POOL_LOCK:
+        with _ROLLOUT_LOCK:
+            return 1
+"""
+
+
+def test_fleet_lock_order_trio():
+    fs = analyze_source(FLEET_LOCK_FIRE, relpath=FLEET_SERVICE_REL,
+                        rules=["lock-order"])
+    assert "lock-order" in names(fs)
+    assert any("cycle" in f.message for f in fs)
+    assert "lock-order" not in names(
+        analyze_source(FLEET_LOCK_SUPPRESSED, relpath=FLEET_SERVICE_REL,
+                       rules=["lock-order"]))
+    assert "lock-order" not in names(
+        analyze_source(FLEET_LOCK_CLEAN, relpath=FLEET_SERVICE_REL,
+                       rules=["lock-order"]))
+    # fleet/ is in scope; the same cycle elsewhere is not audited
+    assert "lock-order" not in names(
+        analyze_source(FLEET_LOCK_FIRE, relpath="lightgbm_tpu/binning.py",
+                       rules=["lock-order"]))
+
+
 # ---- donation-safety ----
 
 DONATION_FIRE = """
@@ -1385,10 +1541,12 @@ TELEMETRY_SCHEMA_FIRE = ('from .obs import emit\n'
 
 RULE_FIXTURES = {
     "host-sync-in-jit": [("HOST_SYNC_BAD", None),
-                         ("INGEST_HOT_LOOP_BAD", "lightgbm_tpu/ingest.py")],
+                         ("INGEST_HOT_LOOP_BAD", "lightgbm_tpu/ingest.py"),
+                         ("FLEET_PROBE_FIRE", FLEET_REPLICA_REL)],
     "retrace-hazard": [("RETRACE_JIT_IN_FN", None)],
     "dtype-drift": [("DTYPE_BAD", None)],
-    "unlocked-shared-state": [("SHARED_BAD", "lightgbm_tpu/serving.py")],
+    "unlocked-shared-state": [("SHARED_BAD", "lightgbm_tpu/serving.py"),
+                              ("FLEET_SHARED_FIRE", FLEET_ROLLOUT_REL)],
     "unsharded-transfer": [("UNSHARDED_BAD", "lightgbm_tpu/ingest.py")],
     "swallowed-device-error": [("SWALLOWED_BAD", "lightgbm_tpu/serving.py")],
     "non-atomic-artifact-write": [("ATOMIC_WRITE_FIRE", None)],
@@ -1400,7 +1558,8 @@ RULE_FIXTURES = {
                           "lightgbm_tpu/somewhere.py")],
     "lock-order": [("LOCK_CYCLE_FIRE", SERVE_REL),
                    ("LOCK_SELF_DEADLOCK_FIRE", SERVE_REL),
-                   ("CHECK_THEN_ACT_FIRE", SERVE_REL)],
+                   ("CHECK_THEN_ACT_FIRE", SERVE_REL),
+                   ("FLEET_LOCK_FIRE", FLEET_SERVICE_REL)],
     "donation-safety": [("DONATION_FIRE", None)],
     "collective-consistency": [("COLLECTIVE_AXIS_FIRE", None),
                                ("CALLBACK_IN_SHARD_MAP_FIRE", None)],
