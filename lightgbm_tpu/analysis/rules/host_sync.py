@@ -65,6 +65,10 @@ SCHED_LOOPS: Set[Tuple[str, str]] = {
     # the online feed loop drains a shared source the same way: a bare
     # sleep / un-timed get there stalls every buffered batch behind it
     ("lightgbm_tpu/online.py", "run"),
+    # the async refit worker drains the trigger handoff queue: a bare
+    # sleep or un-timed get there is deaf to shutdown and can pin a
+    # refit cycle behind an idle wait
+    ("lightgbm_tpu/online.py", "_worker_loop"),
     # the periodic metrics flusher must wait on its stop event (bounded,
     # interruptible), never a bare sleep — a sleep there delays shutdown
     # by up to a full flush interval

@@ -38,7 +38,10 @@ from ..core import ModuleContext, Rule, register, root_name
 # the serving engine + microbatch scheduler, the obs sinks, the chunked
 # ingest pipeline, and the serving fleet (balancer/admission/rollout)
 _SCOPE_FILES = ("lightgbm_tpu/serving.py", "lightgbm_tpu/server.py",
-                "lightgbm_tpu/ingest.py", "lightgbm_tpu/online.py")
+                "lightgbm_tpu/ingest.py", "lightgbm_tpu/online.py",
+                # the write-ahead feed log is appended by serve-handler
+                # threads and scanned/committed by the refit worker
+                "lightgbm_tpu/wal.py")
 _SCOPE_DIRS = ("lightgbm_tpu/obs/", "lightgbm_tpu/fleet/")
 _MUTATING_METHODS = {"append", "extend", "add", "update", "setdefault",
                      "pop", "popitem", "clear", "remove", "insert",

@@ -283,7 +283,13 @@ def run_online(conf: Config, params: Dict) -> None:
     newline protocol on that port concurrently (``!learn`` lines feed the
     same trainer) and the feed file is followed until interrupted; with no
     port the feed is drained once and the final model saved — a batch
-    catch-up job."""
+    catch-up job.
+
+    With ``online_wal=1`` the feed is tailed with per-row batch ids and
+    every batch write-ahead-logged, so a crashed run restarted with the
+    same params resumes exactly-once: the trainer reloads the committed
+    model artifact, replays unacknowledged batches, and the re-read of the
+    feed file from the start deduplicates against the logged ids."""
     import threading
     if not conf.data:
         log.fatal("No training data: set data=<file>")
@@ -302,6 +308,11 @@ def run_online(conf: Config, params: Dict) -> None:
     trainer = OnlineTrainer(params, train_set, booster=booster,
                             server=server)
     server.attach_online(trainer)
+    if trainer.recovery:
+        log.info(f"online: WAL recovery re-appended "
+                 f"{trainer.recovery['committed']} committed and replayed "
+                 f"{trainer.recovery['replayed']} pending batches "
+                 f"({trainer.recovery['rows']} rows)")
     stop = threading.Event()
     follow = conf.serve_port > 0
     if follow:
@@ -311,7 +322,9 @@ def run_online(conf: Config, params: Dict) -> None:
     flush_owner = obs.start_periodic_flush(conf.metrics_flush_secs)
     try:
         fed = trainer.run(tail_source(conf.online_feed, stop=stop,
-                                      follow=follow), stop=stop)
+                                      follow=follow,
+                                      with_ids=bool(conf.online_wal)),
+                          stop=stop)
         log.info(f"online: fed {fed} rows over {trainer.cycles} refit "
                  f"cycles (version {trainer.version})")
     except KeyboardInterrupt:
@@ -321,6 +334,7 @@ def run_online(conf: Config, params: Dict) -> None:
     finally:
         obs.stop_periodic_flush(flush_owner)
         server.close()
+        trainer.close()
         trainer.booster.save_model(conf.output_model)
         log.info(f"Finished online training; model saved to "
                  f"{conf.output_model}")

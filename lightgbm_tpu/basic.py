@@ -31,7 +31,7 @@ from .models.gbdt import GBDT
 from .models.tree import Tree, stack_trees
 from .objectives import create_objective
 from .ops import predict as P
-from .utils import log
+from .utils import faults, log
 from .io import model_text
 
 
@@ -589,7 +589,7 @@ class Dataset:
         return ds
 
     def append(self, data, label=None, weight=None, group=None,
-               init_score=None) -> "Dataset":
+               init_score=None, max_rows: Optional[int] = None) -> "Dataset":
         """Append fresh rows to a CONSTRUCTED dataset under FROZEN binning.
 
         The continuous-training growth path (reference analog: the refit /
@@ -612,6 +612,18 @@ class Dataset:
         matrix (the fused step captures its padded shape); build a new one
         (or ``train(init_model=...)``) after appending — the online loop in
         ``lightgbm_tpu.online`` does exactly that.
+
+        ``max_rows`` (default: the ``online_max_rows`` param) bounds the
+        grown total as a FIFO sliding window: once ``old + new`` exceeds the
+        cap, the oldest rows are evicted so exactly the newest ``max_rows``
+        remain. Bins, EFB plan and feature map stay frozen — the window is a
+        row slice of the matrix the model already understands — and under a
+        RowShardPlan the window is re-planned and redistributed like any
+        other append. Training on the evicted dataset is bit-identical to a
+        ``reference=``-aligned construct of the same window (the sliding-
+        window guarantee continuous training relies on, docs/ONLINE.md).
+        Grouped (ranking) data refuses a cap: a FIFO row window would split
+        query groups.
         """
         self.construct()
         if _is_scipy_sparse(data):
@@ -646,6 +658,12 @@ class Dataset:
         if self.group is not None and group is None:
             log.fatal("Dataset.append: dataset has group boundaries; appended "
                       "rows must supply their own group")
+        conf_cap = int(getattr(conf, "online_max_rows", 0))
+        cap = int(max_rows) if max_rows is not None else conf_cap
+        if cap > 0 and (self.group is not None or group is not None):
+            log.fatal("Dataset.append: online_max_rows eviction is not "
+                      "supported on grouped (ranking) data — a FIFO row "
+                      "window would split query groups")
 
         from . import obs
         from .efb import apply_bundles
@@ -669,9 +687,27 @@ class Dataset:
             encode_threads=conf.encode_threads, encode_fn=_frozen_encode)
         chunks = int(last_stats().get("chunks", 0))
         n_total = old_n + n_new
+        # FIFO sliding window: keep exactly the newest `cap` rows. The
+        # window boundary is a single global row offset, so the kept slice
+        # of the old matrix and the kept tail of the new rows stay in order.
+        evicted = 0
+        keep_old_from = 0
+        new_from = 0
+        if cap > 0 and n_total > cap:
+            evicted = n_total - cap
+            keep_old_from = min(evicted, old_n)
+            new_from = evicted - keep_old_from
+            n_total = cap
         old_plan = self.shard_plan
         resharded = False
-        full = jnp.concatenate([self.bins[:old_n], new_dev], axis=0)
+        full = jnp.concatenate([self.bins[keep_old_from:old_n],
+                                new_dev[new_from:]], axis=0)
+        # the mid-append crash window (kill-and-replay drill): the rows are
+        # encoded and on device but NOTHING in-place has mutated yet, so a
+        # crash here leaves the dataset exactly pre-append — a restart
+        # rebuilds it from the WAL, and an in-process retry of append() is
+        # safe unconditionally (eviction included)
+        faults.fault_point("dataset_append")
         if old_plan is not None:
             # same shard count, grown row total: every row's owner moves, so
             # redistribute onto the re-planned contiguous-block grid (the
@@ -690,12 +726,14 @@ class Dataset:
         self.bins = full
         if self.label is not None:
             self.label = jnp.concatenate(
-                [jnp.asarray(self.label)[:old_n],
-                 jax.device_put(np.asarray(label_new, np.float32))])
+                [jnp.asarray(self.label)[keep_old_from:old_n],
+                 jax.device_put(np.asarray(label_new[new_from:],
+                                           np.float32))])
         if self.weight is not None:
             self.weight = jnp.concatenate(
-                [jnp.asarray(self.weight)[:old_n],
-                 jax.device_put(np.asarray(weight_new, np.float32))])
+                [jnp.asarray(self.weight)[keep_old_from:old_n],
+                 jax.device_put(np.asarray(weight_new[new_from:],
+                                           np.float32))])
         if group is not None:
             g_new = np.asarray(group, dtype=np.int64)
             if int(g_new.sum()) != n_new:
@@ -716,7 +754,8 @@ class Dataset:
                 log.fatal(f"Dataset.append: init_score size {isc_new.size} "
                           f"does not match {n_new} rows x {k} classes")
             self.init_score = np.concatenate(
-                [old_isc.reshape(old_n, k), isc_new.reshape(n_new, k)],
+                [old_isc.reshape(old_n, k)[keep_old_from:],
+                 isc_new.reshape(n_new, k)[new_from:]],
                 axis=0).reshape(-1)
         self._num_data = n_total
         if obs.enabled():
@@ -725,7 +764,7 @@ class Dataset:
                      duration_s=time.time() - t0,
                      num_shards=(self.shard_plan.num_shards
                                  if self.shard_plan is not None else 1),
-                     resharded=resharded)
+                     resharded=resharded, evicted=int(evicted))
         return self
 
     # ---- accessors (reference Dataset API surface) ----

@@ -312,7 +312,9 @@ def online_create(ds, booster, server, params_str: str):
 def online_feed(trainer, data_addr: int, nrow: int, ncol: int,
                 label_addr: int) -> int:
     """Feed one labeled batch; returns the newly published model version
-    when this batch triggered a refit cycle, else 0."""
+    when this batch triggered a synchronous refit cycle, else 0 (always 0
+    with ``online_async_refit=1`` — the cycle runs on the trainer's worker
+    thread and this call never blocks on training)."""
     src = (ctypes.c_double * (nrow * ncol)).from_address(data_addr)
     x = np.frombuffer(src, dtype=np.float64).reshape(nrow, ncol).copy()
     lsrc = (ctypes.c_double * nrow).from_address(label_addr)
@@ -322,6 +324,14 @@ def online_feed(trainer, data_addr: int, nrow: int, ncol: int,
 
 
 def online_flush(trainer) -> int:
-    """Force one refit cycle on whatever rows pend; returns the published
-    version, or 0 when nothing pended."""
+    """Drain pending rows through refit cycles now (synchronous even under
+    ``online_async_refit=1``); returns the published version, or 0 when
+    nothing pended."""
     return int(trainer.flush() or 0)
+
+
+def online_close(trainer) -> int:
+    """Stop the trainer's async refit worker, deregister its freshness
+    collector, and close the write-ahead feed log (idempotent)."""
+    trainer.close()
+    return 0

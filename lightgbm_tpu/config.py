@@ -289,6 +289,26 @@ _PARAMS: Dict[str, Tuple[Any, Tuple[str, ...]]] = {
     # the streaming feed; followed until interrupted when serve_port > 0,
     # else drained once (batch catch-up) and the final model saved
     "online_feed": ("", ("online_feed_file",)),
+    # write-ahead feed log (wal.py): every feed() batch is fsync'd to the
+    # log before it buffers, refit cycles commit only after publish, and a
+    # restarted trainer replays unacknowledged batches — kill -9 anywhere
+    # between feed and publish loses nothing and double-trains nothing
+    "online_wal": (False, ("online_write_ahead_log",)),
+    # WAL + committed-model-artifact directory; empty derives
+    # <dirname(output_model)>/online_wal
+    "online_wal_dir": ("", ()),
+    # bounded sliding-window dataset: Dataset.append evicts the oldest rows
+    # FIFO once the grown total exceeds this cap (bins/EFB stay frozen,
+    # shard plan re-planned for the window; 0 = unbounded growth)
+    "online_max_rows": (0, ("online_window_rows",)),
+    # run triggered refit cycles on a dedicated worker thread with a bounded
+    # handoff queue, so feed() never blocks on training; a failed cycle
+    # keeps serving the last-good version and retries with backoff
+    "online_async_refit": (False, ()),
+    # feed->publish freshness SLO, seconds: each cycle's lag (oldest
+    # buffered row -> publish) is tracked through obs/slo.py with refit_lag
+    # gauges and freshness_breach events (0 = freshness tracking off)
+    "online_freshness_slo_s": (0.0, ("online_freshness_slo",)),
     # ---- observability (new in this framework; see lightgbm_tpu/obs/) ----
     # structured telemetry: schema'd events + metrics around the hot paths;
     # LGBMTPU_TELEMETRY=0/1 env overrides the param in either direction
@@ -500,6 +520,16 @@ class Config:
                       "trigger only)")
         if self.online_boost_rounds < 0:
             log.fatal("online_boost_rounds must be >= 0 (0 = leaf refit only)")
+        if self.online_max_rows < 0:
+            log.fatal("online_max_rows must be >= 0 (0 = unbounded growth)")
+        if 0 < self.online_max_rows < self.online_refit_rows:
+            log.fatal("online_max_rows must be >= online_refit_rows (a "
+                      "window smaller than one refit trigger would evict "
+                      "rows before they can train), got "
+                      f"{self.online_max_rows} < {self.online_refit_rows}")
+        if self.online_freshness_slo_s < 0:
+            log.fatal("online_freshness_slo_s must be >= 0 (0 = freshness "
+                      "tracking off)")
         if not 0 <= self.obs_port <= 65535:
             log.fatal(f"obs_port must be in [0, 65535], got {self.obs_port}")
         if self.serve_slo_ms < 0:

@@ -77,6 +77,8 @@ def configure_from_config(conf) -> None:
     slo.TRACKER.configure(slo_ms=getattr(conf, "serve_slo_ms", None),
                           target=getattr(conf, "serve_slo_target", None),
                           window=getattr(conf, "serve_slo_window", None))
+    slo.FRESHNESS.configure(
+        slo_s=getattr(conf, "online_freshness_slo_s", None))
     flight_dir = (getattr(conf, "flight_dir", "")
                   or getattr(conf, "metrics_out", ""))
     flight.FLIGHT.configure(out_dir=flight_dir,
@@ -103,6 +105,7 @@ def reset() -> None:
         EVENTS.clear()
         METRICS.clear()
         slo.TRACKER.reset()
+        slo.FRESHNESS.reset()
         tracing.TRACES.clear()
         flight.FLIGHT.reset()
 

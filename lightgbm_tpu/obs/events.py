@@ -140,14 +140,39 @@ EVENT_SCHEMAS: Dict[str, Tuple[Dict[str, Any], Dict[str, Any]]] = {
     # shard-grid re-plan + redistribution for the grown row total
     "dataset_append": ({"rows": int, "total_rows": int},
                        {"chunks": int, "duration_s": _NUM, "num_shards": int,
-                        "resharded": bool}),
+                        "resharded": bool, "evicted": int}),
     # one continuous-training refit cycle completed (online.OnlineTrainer):
     # trigger is "rows" / "drift" / "manual" / "flush"; mode is "refit"
     # (leaf-output refit) or "boost" (continued training); publish_s is the
-    # registry publish (engine build + warm) portion of duration_s
+    # registry publish (engine build + warm) portion of duration_s; lag_s is
+    # the feed->publish freshness of the cycle's oldest row; wal_seq is the
+    # highest WAL batch sequence the cycle sealed (WAL on); attempt > 1
+    # marks a retry after a failed cycle
     "online_refit": ({"trigger": str, "rows": int, "version": int},
                      {"duration_s": _NUM, "mode": str, "iteration": int,
-                      "publish_s": _NUM}),
+                      "publish_s": _NUM, "lag_s": _NUM, "wal_seq": int,
+                      "attempt": int}),
+    # a refit cycle FAILED (nonfinite, device fault, exception): the last-
+    # good version keeps serving, the flight recorder dumps (TRIP_EVENTS),
+    # and the async worker retries with backoff — error_class is
+    # "device_fault" or the exception type name
+    "online_cycle_failed": ({"trigger": str, "attempt": int,
+                             "error_class": str},
+                            {"error": str, "rows": int, "backoff_s": _NUM}),
+    # ---- write-ahead feed log (wal.py; docs/ONLINE.md exactly-once) ----
+    # one feed batch became durable (fsync'd + checksummed) in the WAL
+    "wal_append": ({"seq": int, "rows": int}, {"bytes": int}),
+    # a cycle commit record sealed batches <= seq into published `version`
+    "wal_commit": ({"seq": int, "version": int}, {"model": str}),
+    # restart recovery: torn tail truncated, committed batches re-appended
+    # to the Dataset (no retraining), unacknowledged batches replayed
+    "wal_recover": ({"committed": int, "replayed": int},
+                    {"rows": int, "truncated_bytes": int, "model": str,
+                     "duration_s": _NUM}),
+    # feed->publish freshness crossed online_freshness_slo_s (obs/slo.py
+    # FreshnessTracker); emitted on both transitions like slo_breach
+    "freshness_breach": ({"model": str, "lag_s": _NUM, "slo_s": _NUM},
+                         {"recovered": bool, "rows": int}),
     # the eval-metric drift watchdog fired: the current model's metric on
     # the incoming batch drifted past online_drift_metric_delta from the
     # baseline recorded at the previous (re)fit

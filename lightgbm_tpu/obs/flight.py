@@ -27,8 +27,10 @@ from typing import Any, Dict, Optional
 from ..utils import atomic_io
 from .events import _json_default
 
-# event types whose mere occurrence dumps the ring
-TRIP_EVENTS = ("device_fault", "nonfinite_guard")
+# event types whose mere occurrence dumps the ring: device faults, the
+# nonfinite guard, and a failed continuous-training refit cycle (the
+# trainer keeps serving last-good — the dump is the postmortem trail)
+TRIP_EVENTS = ("device_fault", "nonfinite_guard", "online_cycle_failed")
 _DEF_CAPACITY = 512
 _TRIP_DEBOUNCE_S = 1.0
 

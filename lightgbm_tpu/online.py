@@ -9,13 +9,16 @@ traffic. This module is that loop:
 
 1. rows arrive in batches (a callable, an iterator, a tailed CSV file, or
    the serve protocol's ``!learn`` lines) and buffer in
-   :class:`OnlineTrainer`;
+   :class:`OnlineTrainer`; with ``online_wal=1`` every batch is first made
+   durable in a write-ahead feed log (:mod:`.wal`) so a crash at any point
+   between feed and publish loses nothing and double-trains nothing;
 2. a trigger fires — pending rows reached ``online_refit_rows``, the live
    model's eval metric drifted by more than ``online_drift_metric_delta``
    against the baseline recorded at the previous (re)fit, or an explicit
    :meth:`OnlineTrainer.flush` — and the pending rows stream into the
    training Dataset through :meth:`Dataset.append` (frozen bin boundaries +
-   EFB plan, the chunked 3-stage ingest pipeline, shard-plan-aware);
+   EFB plan, the chunked 3-stage ingest pipeline, shard-plan-aware;
+   ``online_max_rows`` bounds the dataset as a FIFO sliding window);
 3. the model updates — ``online_boost_rounds > 0`` continues boosting from
    the current model (``train(init_model=...)``; the delta trees are merged
    back into one servable model by :func:`merge_boosters`), else the leaf
@@ -27,13 +30,23 @@ traffic. This module is that loop:
    refit model with zero dropped requests.
 
 Thread-safety: ``feed``/``flush`` may be called from any thread (the serve
-TCP handler threads do); all trainer state is guarded by one reentrant lock,
-and a refit cycle holds it end-to-end so concurrent feeds order cleanly
-around the dataset append + model swap. The module-level cycle stats mirror
+TCP handler threads do). Two locks split the trainer: ``_lock`` guards the
+cheap mutable state (pend buffers, booster pointer, version/cycle counters,
+drift baseline) and is only ever held briefly; ``_cycle_lock`` serializes
+refit cycles end-to-end. ``feed`` never takes ``_cycle_lock``, so with
+``online_async_refit=1`` feeding never blocks on training: triggers hand off
+through a bounded queue to a dedicated worker thread (a full queue safely
+coalesces — any queued cycle snapshots ALL pending rows). A failed cycle
+keeps serving the last-good model, emits ``online_cycle_failed`` (which
+trips the flight recorder), and retries with exponential backoff; the
+feed->publish lag is watched against ``online_freshness_slo_s`` by
+``obs.slo.FRESHNESS``. The module-level cycle stats mirror
 ``ingest.LAST_INGEST_STATS`` and take their own lock.
 """
 from __future__ import annotations
 
+import os
+import queue
 import threading
 import time
 from typing import Any, Dict, List, Optional
@@ -44,8 +57,9 @@ from . import obs
 from .basic import Booster, Dataset
 from .config import canonical_name, params_to_config
 from .metrics import create_metrics, default_metric_for_objective
-from .utils import log
+from .utils import faults, log
 from .utils.log import LightGBMError
+from .wal import FeedLog
 
 # last completed refit cycle (bench + test introspection); written under
 # _STATS_LOCK only — trainer threads and bench readers race otherwise
@@ -90,35 +104,103 @@ def merge_boosters(init_model: Booster, delta: Booster) -> Booster:
 
 def tail_source(path: str, stop: Optional[threading.Event] = None,
                 poll_s: float = 0.2, follow: bool = True,
-                from_start: bool = True):
-    """Generator over ``(X, y)`` batches appended to a text file of
-    label-first rows (``<label>,<v1>,<v2>,...``, comma or whitespace
-    separated — the CLI ``label_index=0`` convention).
+                from_start: bool = True, with_ids: bool = False):
+    """Generator over batches appended to a text file of label-first rows
+    (``<label>,<v1>,<v2>,...``, comma or whitespace separated — the CLI
+    ``label_index=0`` convention).
+
+    A writer appends incrementally, so a read can end mid-line; the
+    incomplete tail is buffered here until its newline arrives — a partial
+    row is never parsed (and never half-fed). Rotation and truncation are
+    detected when caught up (the path's inode differs from the open handle's,
+    or the file shrank below the read position) and the file is reopened
+    from the start.
+
+    ``with_ids=False`` (default) yields ``(X, y)`` with all complete rows
+    read this poll batched together. ``with_ids=True`` yields one row per
+    batch as ``(X, y, None, batch_id)`` where the id is derived from the
+    file's identity and the row's byte offset — stable across restarts and
+    independent of read chunking, so a restarted producer re-feeding from
+    the start is deduplicated by the trainer's WAL (exactly-once end to
+    end). Offsets assume the ASCII feeds the CLI convention produces.
 
     Yields ``None`` when caught up with the file (the consumer's run loop
     does the bounded waiting — this generator never sleeps), and returns
-    when ``follow=False`` and the end of the file is reached, or when
-    ``stop`` is set."""
+    when ``follow=False`` and the end of the file is reached (a final
+    unterminated line is flushed as end-of-stream), or when ``stop`` is
+    set."""
     stop_ev = stop if stop is not None else threading.Event()
-    with open(path, "r") as fh:
+
+    def _parse(ln: str):
+        ln = ln.split("#", 1)[0].strip()
+        if not ln:
+            return None
+        return [float(t) for t in ln.replace(",", " ").split()]
+
+    def _one(row, start: int, ino: int):
+        arr = np.asarray([row], dtype=np.float64)
+        bid = f"{os.path.basename(path)}:{ino}:{start}"
+        return arr[:, 1:], arr[:, 0], None, bid
+
+    fh = open(path, "r")
+    try:
+        ino = os.fstat(fh.fileno()).st_ino
         if not from_start:
             fh.seek(0, 2)
+        buf = ""
+        off = fh.tell()  # offset of the first unconsumed char (id anchor)
         while not stop_ev.is_set():
-            lines = fh.readlines()
-            if not lines:
-                if not follow:
-                    return
-                yield None
+            chunk = fh.read()
+            if chunk:
+                buf += chunk
+                lines = buf.split("\n")
+                buf = lines.pop()  # incomplete tail: carry to the next read
+                if with_ids:
+                    for ln in lines:
+                        start = off
+                        off += len(ln) + 1
+                        row = _parse(ln)
+                        if row is not None:
+                            yield _one(row, start, ino)
+                else:
+                    rows = []
+                    for ln in lines:
+                        off += len(ln) + 1
+                        row = _parse(ln)
+                        if row is not None:
+                            rows.append(row)
+                    if rows:
+                        arr = np.asarray(rows, dtype=np.float64)
+                        yield arr[:, 1:], arr[:, 0]
                 continue
-            rows = []
-            for ln in lines:
-                ln = ln.split("#", 1)[0].strip()
-                if ln:
-                    rows.append([float(t)
-                                 for t in ln.replace(",", " ").split()])
-            if rows:
-                arr = np.asarray(rows, dtype=np.float64)
-                yield arr[:, 1:], arr[:, 0]
+            # caught up — before idling, check whether the file was rotated
+            # (path now names a different inode) or truncated (shrank below
+            # our read position): either way, reopen and restart from 0
+            try:
+                st = os.stat(path)
+            except OSError:
+                st = None
+            if st is not None and (st.st_ino != ino or
+                                   st.st_size < fh.tell()):
+                fh.close()
+                fh = open(path, "r")
+                ino = os.fstat(fh.fileno()).st_ino
+                buf = ""
+                off = 0
+                continue
+            if not follow:
+                if buf:  # end-of-stream flushes a final unterminated line
+                    row = _parse(buf)
+                    if row is not None:
+                        if with_ids:
+                            yield _one(row, off, ino)
+                        else:
+                            arr = np.asarray([row], dtype=np.float64)
+                            yield arr[:, 1:], arr[:, 0]
+                return
+            yield None
+    finally:
+        fh.close()
 
 
 class OnlineTrainer:
@@ -139,12 +221,33 @@ class OnlineTrainer:
       online_boost_rounds       >0: continue boosting this many rounds per
                                 cycle (mode "boost"); 0: leaf-output refit
                                 of the existing structures (mode "refit")
+      online_wal                1: write-ahead-log every feed batch and
+                                replay unacknowledged ones on restart
+                                (exactly-once; see :mod:`.wal`)
+      online_wal_dir            where the log + model artifacts live
+                                (default: <dir of output_model>/online_wal)
+      online_max_rows           >0: FIFO sliding-window cap on the dataset
+      online_async_refit        1: cycles run on a dedicated worker thread
+                                behind a bounded queue — feed() never blocks
+                                on training
+      online_freshness_slo_s    >0: watch feed->publish lag against this SLO
 
     When ``booster`` is None an initial model is trained on ``dataset``
     (``num_iterations`` rounds). When a server/registry is given, the
     initial model is published only if the name has no current version —
-    ``PredictServer(model=...)`` already published it as v1.
+    ``PredictServer(model=...)`` already published it as v1 (a WAL-recovered
+    committed model supersedes both and republishes).
+
+    Call :meth:`close` when done: it stops the async worker, deregisters
+    the freshness collector and closes the WAL.
     """
+
+    # retry pacing for failed async cycles: base * 2^(attempt-1), capped.
+    # Class attributes so chaos tests can shrink the wait without waiting
+    # wall-clock minutes for the third attempt.
+    RETRY_BACKOFF_S = 0.05
+    RETRY_BACKOFF_MAX_S = 30.0
+    QUEUE_DEPTH = 4
 
     def __init__(self, params: Optional[Dict] = None,
                  dataset: Optional[Dataset] = None,
@@ -167,13 +270,45 @@ class OnlineTrainer:
         self.pending_rows = 0
         self.cycles = 0
         self.version = 0
+        # cycle machinery: _cycle_lock serializes refit cycles end-to-end
+        # (never held by feed); _inflight is the snapshot of a cycle that
+        # failed mid-flight — a retry must finish IT, not re-snapshot, or
+        # already-appended rows would train twice
+        self._cycle_lock = threading.RLock()
+        self._inflight: Optional[Dict[str, Any]] = None
+        self._pend_seq_hi = 0
+        self._pend_oldest_ts: Optional[float] = None
+        self.failures = 0
+        self.coalesced = 0
+        self.last_error = ""
+        self.recovery: Dict[str, Any] = {}
         mnames = self.conf.metric or \
             [default_metric_for_objective(self.conf.objective)]
         ms = create_metrics(mnames[:1], self.conf, self.conf.objective)
         # group metrics (ndcg/map) need query boundaries feed() doesn't
         # carry; drift watching is for the pointwise metric families
         self._metric = ms[0] if ms and ms[0].eval_at is None else None
-        if booster is None:
+        # WAL first: a committed model artifact supersedes both the caller's
+        # booster and a fresh initial train — it IS the durable incumbent
+        self.wal: Optional[FeedLog] = None
+        recovered: Optional[Booster] = None
+        if self.conf.online_wal:
+            wal_dir = self.conf.online_wal_dir or os.path.join(
+                os.path.dirname(self.conf.output_model) or ".", "online_wal")
+            self.wal = FeedLog(wal_dir)
+            lc = self.wal.last_commit
+            if lc and lc.get("model"):
+                mpath = os.path.join(self.wal.dir, str(lc["model"]))
+                if os.path.exists(mpath):
+                    recovered = Booster(params=self.params, model_file=mpath)
+                else:
+                    log.warning(
+                        f"feed WAL commit names a missing model artifact "
+                        f"{mpath}; recovering rows only, starting from the "
+                        f"provided/trained initial model")
+        if recovered is not None:
+            booster = recovered
+        elif booster is None:
             from .engine import train as _train
             booster = _train(self._train_params(), dataset,
                              num_boost_round=self.conf.num_iterations)
@@ -181,8 +316,33 @@ class OnlineTrainer:
         if self.registry is not None:
             try:
                 self.version = self.registry.current(self.name).version
+                if recovered is not None:
+                    # something (PredictServer(model=...)) already published
+                    # a stale initial model; the committed artifact is the
+                    # incumbent, not a canary candidate — publish it direct
+                    self.version = self._publish_direct(booster)
             except KeyError:
                 self.version = self._publish(booster)
+        if self.conf.online_freshness_slo_s > 0:
+            obs.slo.FRESHNESS.configure(
+                slo_s=self.conf.online_freshness_slo_s)
+            self._collector_name = f"online_freshness:{self.name}"
+            obs.add_collector(self._collector_name,
+                              self._freshness_collector)
+        else:
+            self._collector_name = ""
+        self._async = bool(self.conf.online_async_refit)
+        self._stop = threading.Event()
+        self._queue: Optional[queue.Queue] = \
+            queue.Queue(maxsize=self.QUEUE_DEPTH) if self._async else None
+        self._worker: Optional[threading.Thread] = None
+        if self._async:
+            self._worker = threading.Thread(
+                target=self._worker_loop,
+                name=f"lgbm-online-refit-{self.name}", daemon=True)
+            self._worker.start()
+        if self.wal is not None:
+            self._recover(had_commit=recovered is not None)
 
     # ---- internals ----
     def _train_params(self) -> Dict:
@@ -192,29 +352,36 @@ class OnlineTrainer:
         return {k: v for k, v in self.params.items()
                 if canonical_name(str(k)) != "num_iterations"}
 
-    def _publish(self, booster: Booster) -> int:
+    def _publish_direct(self, booster: Booster) -> int:
         if self.server is not None:
-            # with canary_fraction > 0 refit outputs enter through the
-            # rollout gate (fleet/rollout.py) instead of hot-swapping into
-            # live traffic: the comparator judges them against the incumbent
-            # and promotes/rolls back on its own. The very first publish
-            # (version 0 — nothing to compare against) goes direct.
-            if self.conf.canary_fraction > 0 and self.version > 0 and \
-                    hasattr(self.server, "ensure_rollout"):
-                try:
-                    return int(self.server.ensure_rollout(self.name)
-                               .submit_candidate(booster))
-                except LightGBMError as e:
-                    log.warning(f"canary publish unavailable ({e}); "
-                                "publishing direct")
             return int(self.server.publish(booster, name=self.name))
         if self.registry is not None:
             return int(self.registry.publish(self.name, booster).version)
         return self.version + 1
 
-    def _metric_value(self, X, y, w) -> float:
-        pred = self.booster.predict(
-            X, raw_score=not self._metric.use_prob)
+    def _publish(self, booster: Booster) -> int:
+        if self.server is not None and self.conf.canary_fraction > 0 and \
+                self.version > 0 and hasattr(self.server, "ensure_rollout"):
+            # with canary_fraction > 0 refit outputs enter through the
+            # rollout gate (fleet/rollout.py) instead of hot-swapping into
+            # live traffic: the comparator judges them against the incumbent
+            # and promotes/rolls back on its own. The very first publish
+            # (version 0 — nothing to compare against) goes direct.
+            try:
+                return int(self.server.ensure_rollout(self.name)
+                           .submit_candidate(booster))
+            except LightGBMError as e:
+                log.warning(f"canary publish unavailable ({e}); "
+                            "publishing direct")
+        return self._publish_direct(booster)
+
+    def _metric_value(self, X, y, w, booster: Optional[Booster] = None
+                      ) -> float:
+        bst = booster
+        if bst is None:
+            with self._lock:
+                bst = self.booster
+        pred = bst.predict(X, raw_score=not self._metric.use_prob)
         return float(self._metric(np.asarray(y, dtype=np.float64), pred, w))
 
     def _check_drift(self, X, y, w) -> Optional[str]:
@@ -235,10 +402,74 @@ class OnlineTrainer:
             return "drift"
         return None
 
+    def _freshness_collector(self, reg) -> None:
+        """Scrape-time gauge: age of the oldest row still unpublished."""
+        with self._lock:
+            oldest = self._pend_oldest_ts
+        lag = (time.time() - oldest) if oldest else 0.0
+        obs.slo.FRESHNESS.note_pending(self.name, lag)
+
+    # ---- crash recovery (WAL replay) ----
+    def _recover(self, had_commit: bool) -> None:
+        """Rebuild state from the WAL: committed batches re-append their
+        rows (their training effect is already baked into the committed
+        model artifact — append, never retrain); pending batches replay
+        through the normal trigger machinery, which is deterministic, so
+        the recovered model is byte-identical to the uninterrupted run's."""
+        t0 = time.time()
+        # the recovered-model path skipped the initial train (which is what
+        # normally constructs the dataset); replay appends need frozen bins
+        self.dataset.construct()
+        lc = self.wal.last_commit
+        committed = self.wal.committed()
+        pending = self.wal.pending()
+        cap = self.conf.online_max_rows or None
+        if lc is None:
+            # fresh log: seal the starting model as the seq-0 artifact so a
+            # crash before the first cycle commit replays on top of exactly
+            # this model
+            path = self.wal.model_artifact(0)
+            self.booster.save_model(path)
+            self.wal.commit(0, int(self.version),
+                            model=os.path.basename(path), cycle=0)
+            if not pending:
+                return
+        elif had_commit:
+            if lc.get("baseline") is not None:
+                self._baseline = float(lc["baseline"])
+            self.cycles = int(lc.get("cycle", 0))
+            if self.registry is None:
+                self.version = int(lc.get("version", self.version))
+        rows = 0
+        for b in committed:
+            self.dataset.append(b.X, label=b.y, weight=b.w, max_rows=cap)
+            rows += b.rows
+        replayed = 0
+        for b in pending:
+            self._buffer(b.X, b.y, b.w, seq=b.seq)
+            replayed += 1
+            rows += b.rows
+        dur = time.time() - t0
+        self.recovery = {"committed": len(committed),
+                         "replayed": int(replayed), "rows": int(rows),
+                         "truncated_bytes": int(self.wal.truncated_bytes),
+                         "duration_s": dur}
+        obs.emit("wal_recover", committed=len(committed),
+                 replayed=int(replayed), rows=int(rows),
+                 truncated_bytes=int(self.wal.truncated_bytes),
+                 model=str((lc or {}).get("model", "")), duration_s=dur)
+
     # ---- the public loop surface ----
-    def feed(self, data, label, weight=None) -> Optional[int]:
+    def feed(self, data, label, weight=None,
+             batch_id: Optional[str] = None) -> Optional[int]:
         """Buffer one batch; returns the new published version when this
-        batch triggered a refit cycle, else None."""
+        batch triggered a synchronous refit cycle, else None (always None
+        with ``online_async_refit=1`` — the cycle runs on the worker).
+
+        With ``online_wal=1`` the batch is appended to the write-ahead log
+        (fsync'd) BEFORE buffering: once feed returns, the batch survives a
+        crash. A ``batch_id`` already in the log (a producer re-send after
+        its own restart) is dropped — exactly-once is decided by the id."""
         X = np.asarray(data, dtype=np.float64)
         if X.ndim == 1:
             X = X[None, :]
@@ -247,6 +478,17 @@ class OnlineTrainer:
             log.fatal(f"feed: {X.shape[0]} rows but {y.shape[0]} labels")
         w = None if weight is None else \
             np.asarray(weight, dtype=np.float64).reshape(-1)
+        seq = 0
+        if self.wal is not None:
+            if batch_id is not None and self.wal.seen(batch_id):
+                return None
+            try:
+                seq = self.wal.append_batch(X, y, w, batch_id=batch_id)
+            except ValueError:
+                return None  # duplicate id raced in from another thread
+        return self._buffer(X, y, w, seq=seq)
+
+    def _buffer(self, X, y, w, seq: int = 0) -> Optional[int]:
         trigger = None
         with self._lock:
             self._pend_x.append(X)
@@ -254,44 +496,110 @@ class OnlineTrainer:
             if w is not None:
                 self._pend_w.append(w)
             self.pending_rows += int(y.shape[0])
+            if seq:
+                self._pend_seq_hi = max(self._pend_seq_hi, int(seq))
+            if self._pend_oldest_ts is None:
+                self._pend_oldest_ts = time.time()
             if self.pending_rows >= self.conf.online_refit_rows:
                 trigger = "rows"
         if trigger is None:
             trigger = self._check_drift(X, y, w)
         if trigger is not None:
+            if self._async:
+                self._submit(trigger)
+                return None
             return self.refit_now(trigger=trigger)
         return None
 
     def flush(self) -> Optional[int]:
-        """Drain pending rows through one refit cycle now (end-of-stream)."""
-        return self.refit_now(trigger="flush")
+        """Drain pending rows through refit cycles now (end-of-stream).
+        Synchronous even in async mode: serializes against the worker via
+        the cycle lock and loops until nothing pends (a failed cycle may
+        have left rows buffered behind the retrying in-flight snapshot)."""
+        version = self.refit_now(trigger="flush")
+        while True:
+            with self._lock:
+                pend = self.pending_rows
+            if not pend:
+                return version
+            v = self.refit_now(trigger="flush")
+            if v is None:
+                return version
+            version = v
 
     def refit_now(self, trigger: str = "manual") -> Optional[int]:
         """One full cycle: append pending rows, refit/continue the model,
-        publish. Returns the published version, or None if nothing pended."""
+        publish, commit to the WAL. Returns the published version, or None
+        if nothing pended. On failure the last-good model keeps serving,
+        the failure is recorded (``online_cycle_failed`` trips the flight
+        recorder) and the snapshot is kept for an idempotent retry."""
+        with self._cycle_lock:
+            cyc = self._snapshot_cycle(trigger)
+            if cyc is None:
+                return None
+            try:
+                return self._run_cycle(cyc)
+            except Exception as e:
+                self._note_failure(cyc, e)
+                raise
+
+    def _snapshot_cycle(self, trigger: str) -> Optional[Dict[str, Any]]:
+        # under _cycle_lock
+        if self._inflight is not None:
+            cyc = self._inflight
+            cyc["attempt"] += 1
+            return cyc
         with self._lock:
             if not self.pending_rows:
                 return None
-            t0 = time.time()
             X = np.concatenate(self._pend_x, axis=0)
             y = np.concatenate(self._pend_y)
             w = np.concatenate(self._pend_w) if self._pend_w else None
-            n = self.pending_rows
+            cyc = {"trigger": trigger, "X": X, "y": y, "w": w,
+                   "n": int(self.pending_rows),
+                   "seq": int(self._pend_seq_hi),
+                   "oldest": self._pend_oldest_ts,
+                   "attempt": 1, "appended": False}
             self._pend_x, self._pend_y, self._pend_w = [], [], []
             self.pending_rows = 0
-            self.dataset.append(X, label=y, weight=w)
-            mode = "boost" if self.conf.online_boost_rounds > 0 else "refit"
-            if mode == "boost":
-                from .engine import train as _train
-                delta = _train(self._train_params(), self.dataset,
-                               num_boost_round=self.conf.online_boost_rounds,
-                               init_model=self.booster)
-                new_bst = merge_boosters(self.booster, delta)
-            else:
-                new_bst = self.booster.refit(X, y, weight=w)
-            t_pub = time.time()
-            version = self._publish(new_bst)
-            publish_s = time.time() - t_pub
+            self._pend_oldest_ts = None
+            self._inflight = cyc
+        return cyc
+
+    def _run_cycle(self, cyc: Dict[str, Any]) -> int:
+        # under _cycle_lock
+        t0 = time.time()
+        X, y, w, n = cyc["X"], cyc["y"], cyc["w"], cyc["n"]
+        trigger = cyc["trigger"]
+        if not cyc["appended"]:
+            self.dataset.append(X, label=y, weight=w,
+                                max_rows=self.conf.online_max_rows or None)
+            cyc["appended"] = True  # a retry must not append twice
+        faults.fault_point("online_train")
+        with self._lock:
+            init = self.booster
+        mode = "boost" if self.conf.online_boost_rounds > 0 else "refit"
+        if mode == "boost":
+            from .engine import train as _train
+            delta = _train(self._train_params(), self.dataset,
+                           num_boost_round=self.conf.online_boost_rounds,
+                           init_model=init)
+            new_bst = merge_boosters(init, delta)
+        else:
+            new_bst = init.refit(X, y, weight=w)
+        faults.fault_point("online_publish")
+        model_name = ""
+        if self.wal is not None:
+            # artifact BEFORE publish+commit, atomically (save_model goes
+            # through utils/atomic_io): the commit record may only ever
+            # name a fully-written model
+            apath = self.wal.model_artifact(cyc["seq"])
+            new_bst.save_model(apath)
+            model_name = os.path.basename(apath)
+        t_pub = time.time()
+        version = self._publish(new_bst)
+        publish_s = time.time() - t_pub
+        with self._lock:
             self.booster = new_bst
             self.version = version
             self.cycles += 1
@@ -300,20 +608,81 @@ class OnlineTrainer:
             # was the model when it was last fit", not against history
             if self._metric is not None and \
                     self.conf.online_drift_metric_delta > 0:
-                self._baseline = self._metric_value(X, y, w)
-            duration_s = time.time() - t0
-            obs.emit("online_refit", trigger=trigger, rows=int(n),
-                     version=int(version), duration_s=duration_s, mode=mode,
-                     iteration=int(new_bst.current_iteration),
-                     publish_s=publish_s)
+                self._baseline = self._metric_value(X, y, w, booster=new_bst)
+            baseline = self._baseline
+            cycles = self.cycles
+        if self.wal is not None:
+            self.wal.commit(int(cyc["seq"]), int(version), model=model_name,
+                            baseline=baseline, cycle=cycles)
+        lag_s = (time.time() - cyc["oldest"]) if cyc["oldest"] else 0.0
+        obs.slo.FRESHNESS.observe_cycle(self.name, lag_s, rows=int(n))
+        duration_s = time.time() - t0
+        obs.emit("online_refit", trigger=trigger, rows=int(n),
+                 version=int(version), duration_s=duration_s, mode=mode,
+                 iteration=int(new_bst.current_iteration),
+                 publish_s=publish_s, lag_s=float(lag_s),
+                 wal_seq=int(cyc["seq"]), attempt=int(cyc["attempt"]))
         with _STATS_LOCK:
             LAST_CYCLE_STATS.clear()
             LAST_CYCLE_STATS.update({
                 "trigger": trigger, "mode": mode, "rows": int(n),
                 "total_rows": int(self.dataset.num_data),
                 "version": int(version), "duration_s": duration_s,
-                "publish_s": publish_s})
+                "publish_s": publish_s, "lag_s": float(lag_s),
+                "wal_seq": int(cyc["seq"]), "attempt": int(cyc["attempt"])})
+        self._inflight = None  # under _cycle_lock (refit_now holds it)
         return version
+
+    def _note_failure(self, cyc: Dict[str, Any], err: Exception) -> None:
+        with self._lock:
+            self.failures += 1
+            self.last_error = f"{type(err).__name__}: {err}"
+        obs.emit("online_cycle_failed", trigger=str(cyc["trigger"]),
+                 attempt=int(cyc["attempt"]),
+                 error_class=type(err).__name__,
+                 error=str(err), rows=int(cyc["n"]))
+
+    # ---- async worker ----
+    def _submit(self, trigger: str, attempt: int = 1) -> None:
+        try:
+            self._queue.put_nowait((str(trigger), int(attempt)))
+        except queue.Full:
+            # safe coalescing: any queued cycle snapshots ALL pending rows,
+            # so a dropped trigger's rows still train with the next cycle
+            with self._lock:
+                self.coalesced += 1
+
+    def _worker_loop(self) -> None:
+        while True:
+            if self._stop.is_set():
+                return
+            try:
+                trigger, attempt = self._queue.get(timeout=0.1)
+            except queue.Empty:
+                continue
+            try:
+                self.refit_now(trigger=trigger)
+            except Exception:
+                # recorded + flight-dumped by refit_now already: keep
+                # serving last-good, retry after bounded backoff
+                delay = min(self.RETRY_BACKOFF_MAX_S,
+                            self.RETRY_BACKOFF_S * (2.0 ** (attempt - 1)))
+                if self._stop.wait(delay):
+                    return
+                self._submit(trigger, attempt + 1)
+
+    def close(self) -> None:
+        """Stop the async worker, deregister the freshness collector, close
+        the WAL. Idempotent; don't feed the trainer afterwards."""
+        if self._worker is not None:
+            self._stop.set()
+            self._worker.join(timeout=10.0)
+            self._worker = None
+        if self._collector_name:
+            obs.remove_collector(self._collector_name)
+            self._collector_name = ""
+        if self.wal is not None:
+            self.wal.close()
 
     def statusz(self) -> Dict[str, Any]:
         """Live trainer state for the ObsServer /statusz endpoint."""
@@ -324,7 +693,23 @@ class OnlineTrainer:
                    "total_rows": int(self.dataset.num_data),
                    "mode": ("boost" if self.conf.online_boost_rounds > 0
                             else "refit"),
-                   "drift_baseline": self._baseline}
+                   "drift_baseline": self._baseline,
+                   "async": bool(self._async),
+                   "failures": int(self.failures),
+                   "coalesced": int(self.coalesced)}
+            if self.last_error:
+                out["last_error"] = self.last_error
+            oldest = self._pend_oldest_ts
+        out["pending_lag_s"] = (time.time() - oldest) if oldest else 0.0
+        if self._queue is not None:
+            out["queued"] = int(self._queue.qsize())
+        if self.wal is not None:
+            out["wal"] = self.wal.stats()
+        if self.recovery:
+            out["recovery"] = dict(self.recovery)
+        fresh = obs.slo.FRESHNESS.snapshot().get(self.name)
+        if fresh:
+            out["freshness"] = fresh
         last = last_cycle_stats()
         if last:
             out["last_cycle"] = last
@@ -332,8 +717,8 @@ class OnlineTrainer:
 
     def run(self, source, stop: Optional[threading.Event] = None,
             poll_s: float = 0.05, flush_at_end: bool = True) -> int:
-        """Consume ``(X, y[, w])`` batches from ``source`` until it ends or
-        ``stop`` is set; returns the number of rows fed.
+        """Consume ``(X, y[, w[, batch_id]])`` batches from ``source`` until
+        it ends or ``stop`` is set; returns the number of rows fed.
 
         ``source`` is an iterable/generator of batches (``tail_source``), or
         a zero-arg callable polled each step. ``None`` from either means
@@ -358,7 +743,8 @@ class OnlineTrainer:
                 continue
             X, y = batch[0], batch[1]
             w = batch[2] if len(batch) > 2 else None
-            self.feed(X, y, weight=w)
+            bid = batch[3] if len(batch) > 3 else None
+            self.feed(X, y, weight=w, batch_id=bid)
             fed += int(np.asarray(y).reshape(-1).shape[0])
         if flush_at_end and self.pending_rows:
             self.flush()

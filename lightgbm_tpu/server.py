@@ -872,6 +872,9 @@ class PredictServer:
 #   !publish <path>    hot-swap     ->  "ok version=<n>"
 #   !learn <y>,<v1>,.. labeled row into the attached OnlineTrainer
 #                                   ->  "ok pending=<n>[ version=<v>]"
+#                      (version only when the row triggered a synchronous
+#                      refit; under online_async_refit the cycle runs on
+#                      the trainer's worker and the reply never waits)
 #   !canary <path> [fraction] [shadow|canary]
 #                      start a rollout -> "ok version=<n> mode=<m>"
 #   !promote           promote the canary now -> "ok version=<n>"

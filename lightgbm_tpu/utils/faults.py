@@ -44,6 +44,22 @@ point                     fires in
 ``prewarm_compile``       prewarm.py — inside the background AOT compile
                           worker (a failed prewarm must degrade to
                           compile-at-dispatch, never break training)
+``wal_append``            wal.py — right AFTER a feed batch is fsync'd into
+                          the write-ahead feed log, before it buffers (the
+                          post-WAL-append crash window of the kill-and-
+                          replay drill: the batch is durable but untrained)
+``dataset_append``        basic.py Dataset.append — mid-append, after the
+                          fresh rows are encoded + on device but before any
+                          in-place mutation of the dataset (crash here
+                          leaves it exactly pre-append, so both a restart's
+                          WAL replay and an in-process retry are safe)
+``online_train``          online.py refit cycle — after the Dataset append,
+                          before the model update (mid-train crash: rows
+                          durable + appended, model never produced)
+``online_publish``        online.py refit cycle — after the new model was
+                          built, before artifact save + publish + WAL
+                          commit (pre-publish crash: replay retrains the
+                          same batches deterministically)
 ========================  ===================================================
 
 The last four are the DEVICE-level chaos points (:data:`DEVICE_FAULT_POINTS`)
@@ -67,7 +83,12 @@ ENV_VAR = "LGBMTPU_FAULTS"
 
 KNOWN_POINTS = ("snapshot_write", "mapper_allgather", "dist_init",
                 "tree_update", "shard_commit", "hist_allreduce",
-                "device_put_oom", "prewarm_compile")
+                "device_put_oom", "prewarm_compile",
+                # continuous-training crash windows (kill-and-replay drill,
+                # tests/test_online_wal.py): feed -> append -> train ->
+                # publish, one point per window
+                "wal_append", "dataset_append", "online_train",
+                "online_publish")
 
 # chaos points that simulate DEVICE failures (OOM, lost chip, dead
 # collective): their injected errors classify as device faults and route

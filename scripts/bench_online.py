@@ -15,6 +15,10 @@ Measures the three costs the online loop (online.py) exists to bound:
 - ``hot_swap``: the served-QPS dip across a refit+publish under closed-loop
   load — QPS in the windows before / during / after the swap, zero shed
   and zero errors asserted from the scheduler's counters.
+- ``wal``: what exactly-once costs — feed() throughput with the write-ahead
+  feed log on vs off (every batch fsync'd before buffering), crash-recovery
+  time (log scan + trainer replay + catch-up cycle over the same batches),
+  and feed->publish freshness latency in sync and async refit modes.
 
 Usage: python scripts/bench_online.py [--quick] [out.json]
 Env: LGBM_TPU_ONLINE_BENCH_ROWS / _ITERS / _SECONDS / _CLIENTS
@@ -126,6 +130,102 @@ def run(out_path=None, quick=False):
               f"(publish {st['publish_s']:.3f}s)", file=sys.stderr)
         srv.close()
 
+    # ---- WAL: durable-append overhead, crash recovery, freshness ----
+    import shutil
+    import tempfile
+    from lightgbm_tpu.wal import FeedLog
+
+    wal_root = tempfile.mkdtemp(prefix="lgbm_wal_bench_")
+    n_b = 40 if quick else 200
+    rows_b = 256
+    fb_X, fb_y = X[:rows_b], y[:rows_b]
+    wal = {}
+    try:
+        for label, wal_on in (("wal_off", False), ("wal_on", True)):
+            wp = dict(params)
+            wp.update({"online_refit_rows": 10 ** 9,
+                       "online_boost_rounds": 0, "online_wal": wal_on,
+                       "online_wal_dir": os.path.join(wal_root, label)})
+            wds = lgb.Dataset(X[:half], label=y[:half], params=wp)
+            tr = OnlineTrainer(wp, wds, booster=booster)
+            t0 = time.perf_counter()
+            for i in range(n_b):
+                tr.feed(fb_X, fb_y, batch_id=f"bench-{i:05d}")
+            feed_s = time.perf_counter() - t0
+            wal[label] = {
+                "batches": n_b, "rows": n_b * rows_b,
+                "feed_s": round(feed_s, 3),
+                "feed_rows_per_s": round(n_b * rows_b / feed_s, 1),
+            }
+            if wal_on:
+                wal[label]["log_bytes"] = tr.wal.stats()["bytes"]
+            tr.close()   # pending stays unacknowledged: the replay corpus
+        wal["append_overhead_x"] = round(
+            wal["wal_off"]["feed_rows_per_s"] /
+            wal["wal_on"]["feed_rows_per_s"], 2)
+        print(f"# feed: {wal['wal_off']['feed_rows_per_s']:,.0f} rows/s "
+              f"wal-off vs {wal['wal_on']['feed_rows_per_s']:,.0f} wal-on "
+              f"({wal['append_overhead_x']}x)", file=sys.stderr)
+
+        # crash recovery over the wal_on log: scan, replay, catch-up train
+        wp = dict(params)
+        wp.update({"online_refit_rows": 10 ** 9, "online_boost_rounds": 0,
+                   "online_wal": True,
+                   "online_wal_dir": os.path.join(wal_root, "wal_on")})
+        t0 = time.perf_counter()
+        fl = FeedLog(wp["online_wal_dir"])
+        scan_s = time.perf_counter() - t0
+        pending = len(fl.pending())
+        fl.close()
+        wds = lgb.Dataset(X[:half], label=y[:half], params=wp)
+        t0 = time.perf_counter()
+        tr = OnlineTrainer(wp, wds, booster=booster)   # replays the log
+        tr.flush()                                     # catch-up cycle
+        replay_total_s = time.perf_counter() - t0
+        wal["recovery"] = {
+            "pending_batches": pending,
+            "scan_s": round(scan_s, 4),
+            "recover_s": round(tr.recovery.get("duration_s", 0.0), 4),
+            "replay_to_caught_up_s": round(replay_total_s, 3),
+            "replayed_rows": tr.recovery.get("rows", 0),
+        }
+        print(f"# recovery: scanned {pending} batches in {scan_s:.3f}s, "
+              f"caught up in {replay_total_s:.3f}s", file=sys.stderr)
+        tr.close()
+
+        # feed->publish freshness: sync (feed blocks through the cycle)
+        # vs async (feed returns at queue handoff; worker publishes)
+        fresh = {}
+        for label, async_on in (("sync", False), ("async", True)):
+            fp = dict(params)
+            fp.update({"online_refit_rows": rows_b,
+                       "online_boost_rounds": 0, "online_wal": True,
+                       "online_async_refit": async_on,
+                       "online_wal_dir": os.path.join(wal_root,
+                                                      f"fresh_{label}")})
+            fds = lgb.Dataset(X[:half], label=y[:half], params=fp)
+            tr = OnlineTrainer(fp, fds, booster=booster)
+            t0 = time.perf_counter()
+            tr.feed(fb_X, fb_y, batch_id="fresh")      # triggers one cycle
+            feed_ret_s = time.perf_counter() - t0
+            deadline = time.time() + 120
+            while tr.cycles < 1 and time.time() < deadline:
+                time.sleep(0.002)
+            publish_s = time.perf_counter() - t0
+            fresh[label] = {
+                "feed_return_s": round(feed_ret_s, 4),
+                "feed_to_publish_s": round(publish_s, 3),
+                "lag_s": round(last_cycle_stats().get("lag_s", 0.0), 3),
+            }
+            tr.close()
+        wal["freshness"] = fresh
+        print(f"# freshness: sync feed blocks "
+              f"{fresh['sync']['feed_return_s']:.3f}s; async returns in "
+              f"{fresh['async']['feed_return_s']:.4f}s, publishes in "
+              f"{fresh['async']['feed_to_publish_s']:.3f}s", file=sys.stderr)
+    finally:
+        shutil.rmtree(wal_root, ignore_errors=True)
+
     # ---- served-QPS dip across a mid-load refit + hot swap ----
     hp = dict(params)
     hp.update({"online_refit_rows": 10 ** 9, "online_boost_rounds": 0})
@@ -196,6 +296,7 @@ def run(out_path=None, quick=False):
                   "max_bin": 63, "features": int(X.shape[1])},
         "append": append,
         "cycles": cycles,
+        "wal": wal,
         "hot_swap": hot_swap,
     }
     doc = json.dumps(result, indent=2)

@@ -1,0 +1,577 @@
+"""Exactly-once continuous training (ISSUE 14): write-ahead feed log,
+kill-and-replay chaos drill, async refit with freshness SLO, bounded
+sliding-window datasets, and the fixed partial-line/rotation file tailer.
+
+The crash contract under test: a simulated ``kill -9`` (FaultInjected at a
+registered crash point, trainer + dataset discarded) at ANY point between
+``feed()`` and publish, followed by a restart (fresh trainer over the same
+WAL dir, producer re-sending every batch with the same ids), yields a model
+byte-identical to the uninterrupted run's — zero lost batches, zero
+double-trained batches, asserted from the WAL's sequence numbers.
+"""
+import glob
+import os
+import threading
+import time
+
+import numpy as np
+import pytest
+
+import lightgbm_tpu as lgb
+from lightgbm_tpu import obs
+from lightgbm_tpu.basic import Dataset
+from lightgbm_tpu.config import params_to_config
+from lightgbm_tpu.online import OnlineTrainer, tail_source
+from lightgbm_tpu.utils import faults
+from lightgbm_tpu.utils.faults import FaultInjected
+from lightgbm_tpu.utils.log import LightGBMError
+from lightgbm_tpu.wal import FeedLog
+
+
+@pytest.fixture(scope="module", autouse=True)
+def _lockwatch_zero_inversions():
+    from lightgbm_tpu.analysis import lockwatch
+    yield
+    lockwatch.WATCH.assert_clean("tests/test_online_wal.py")
+
+
+@pytest.fixture(autouse=True)
+def _clean_faults_and_obs():
+    faults.reset()
+    yield
+    faults.reset()
+    obs.configure(enabled=False)
+    obs.reset()
+
+
+N_FEAT = 4
+
+
+def _make_data(n=120, f=N_FEAT, seed=5):
+    rng = np.random.RandomState(seed)
+    X = rng.rand(n, f)
+    y = X[:, 0] + 0.5 * X[:, 1] + 0.05 * rng.rand(n)
+    return X, y
+
+
+def _batches(n_batches=10, rows=10, f=N_FEAT, seed=77):
+    rng = np.random.RandomState(seed)
+    out = []
+    for i in range(n_batches):
+        X = rng.rand(rows, f)
+        out.append((X, X[:, 0] + 0.5 * X[:, 1], f"b{i:03d}"))
+    return out
+
+
+def _params(wal_dir, **extra):
+    p = {"objective": "regression", "num_leaves": 7, "verbose": -1,
+         "min_data_in_leaf": 5, "num_iterations": 3,
+         "online_refit_rows": 30, "online_boost_rounds": 2,
+         "online_wal": True, "online_wal_dir": str(wal_dir)}
+    p.update(extra)
+    return p
+
+
+def _fresh_trainer(params):
+    """A from-scratch trainer over a from-scratch base dataset — what a
+    restarted process would build before WAL recovery kicks in."""
+    X0, y0 = _make_data()
+    return OnlineTrainer(params, Dataset(X0, label=y0, params=params))
+
+
+# ---- FeedLog units ----
+
+def test_wal_roundtrip(tmp_path):
+    fl = FeedLog(str(tmp_path / "w"))
+    bs = _batches(3, rows=4)
+    w = np.linspace(1.0, 2.0, 4)
+    assert fl.append_batch(bs[0][0], bs[0][1], batch_id=bs[0][2]) == 1
+    assert fl.append_batch(bs[1][0], bs[1][1], w) == 2
+    assert fl.append_batch(bs[2][0], bs[2][1]) == 3
+    assert fl.seen(bs[0][2]) and not fl.seen("nope")
+    with pytest.raises(ValueError):
+        fl.append_batch(bs[0][0], bs[0][1], batch_id=bs[0][2])
+    fl.commit(2, version=7, model="model_00000002.txt", baseline=0.5,
+              cycle=1)
+    fl.close()
+    # reopen: everything decodes back bit-exactly, split at the commit
+    fl2 = FeedLog(str(tmp_path / "w"))
+    assert fl2.last_seq == 3 and fl2.committed_seq == 2
+    assert fl2.truncated_bytes == 0
+    lc = fl2.last_commit
+    assert lc["version"] == 7 and lc["model"] == "model_00000002.txt"
+    assert lc["baseline"] == 0.5 and lc["cycle"] == 1
+    committed, pending = fl2.committed(), fl2.pending()
+    assert [b.seq for b in committed] == [1, 2]
+    assert [b.seq for b in pending] == [3]
+    np.testing.assert_array_equal(committed[0].X, bs[0][0])
+    np.testing.assert_array_equal(committed[0].y, bs[0][1])
+    assert committed[0].batch_id == bs[0][2]
+    np.testing.assert_array_equal(committed[1].w, w)
+    assert pending[0].w is None
+    assert fl2.seen(bs[0][2])
+    st = fl2.stats()
+    assert st["batches"] == 3 and st["last_seq"] == 3
+    assert st["committed_seq"] == 2 and st["bytes"] > 0
+    fl2.close()
+    assert fl2.closed
+
+
+def test_wal_torn_tail_truncated(tmp_path):
+    fl = FeedLog(str(tmp_path / "w"))
+    bs = _batches(3, rows=6)
+    for X, y, bid in bs:
+        fl.append_batch(X, y, batch_id=bid)
+    fl.close()
+    # crash mid-append: chop the last record in half
+    path = os.path.join(str(tmp_path / "w"), "feed.wal")
+    size = os.path.getsize(path)
+    with open(path, "r+b") as fh:
+        fh.truncate(size - 37)
+    fl2 = FeedLog(str(tmp_path / "w"))
+    assert fl2.truncated_bytes > 0
+    assert [b.seq for b in fl2.pending()] == [1, 2]
+    assert not fl2.seen(bs[2][2])   # the torn batch was never acknowledged
+    # the log keeps appending after recovery, sequence numbers continue
+    assert fl2.append_batch(bs[2][0], bs[2][1], batch_id=bs[2][2]) == 3
+    fl2.close()
+    fl3 = FeedLog(str(tmp_path / "w"))
+    assert fl3.truncated_bytes == 0 and fl3.last_seq == 3
+    assert [b.seq for b in fl3.pending()] == [1, 2, 3]
+    fl3.close()
+
+
+def test_wal_scan_dedups_duplicate_ids(tmp_path):
+    # a producer re-send that raced a crash can leave two records with the
+    # same id in the file; the scan keeps the first occurrence only
+    fl = FeedLog(str(tmp_path / "w"))
+    X, y, bid = _batches(1, rows=5)[0]
+    fl.append_batch(X, y, batch_id=bid)
+    with fl._lock:   # forge the duplicate the public API refuses to write
+        fl._append_record(1, 2, {"rows": 5, "cols": N_FEAT, "w": False,
+                                 "id": bid},
+                          np.ascontiguousarray(X).tobytes() +
+                          np.ascontiguousarray(y).tobytes())
+    fl.close()
+    fl2 = FeedLog(str(tmp_path / "w"))
+    assert [b.seq for b in fl2.pending()] == [1]
+    assert fl2.last_seq == 2
+    fl2.close()
+
+
+# ---- the kill-and-replay chaos drill ----
+
+CRASH_POINTS = ("wal_append", "dataset_append", "online_train",
+                "online_publish")
+
+
+def _run_until_crash(tr, batches):
+    """Feed + flush until a FaultInjected 'kills' the process; returns True
+    if it crashed. The caller discards the trainer + dataset afterwards —
+    that discard IS the kill -9 simulation (nothing in-memory survives)."""
+    try:
+        for X, y, bid in batches:
+            tr.feed(X, y, batch_id=bid)
+        tr.flush()
+    except FaultInjected:
+        return True
+    return False
+
+
+def test_kill_and_replay_byte_identical(tmp_path, monkeypatch):
+    batches = _batches(10, rows=10)
+    # model text echoes every param, online_wal_dir included — byte-identity
+    # needs the SAME dir string in every run, so each run gets its own cwd
+    # and a relative "wal"
+    base = tmp_path / "base"
+    base.mkdir()
+    monkeypatch.chdir(base)
+    params = _params("wal")
+
+    # the uninterrupted run: the reference for byte-identity
+    tr = _fresh_trainer(params)
+    assert not _run_until_crash(tr, batches)
+    want_text = tr.booster.model_to_string()
+    want_rows = tr.dataset.num_data
+    assert tr.wal.committed_seq == tr.wal.last_seq == len(batches)
+    tr.close()
+
+    for point in CRASH_POINTS:
+        d = tmp_path / point
+        d.mkdir()
+        monkeypatch.chdir(d)
+        faults.configure(f"{point}:1")
+        tr1 = _fresh_trainer(params)
+        crashed = _run_until_crash(tr1, batches)
+        faults.reset()
+        assert crashed, f"fault point {point} never fired"
+        tr1.wal.close()   # the fd would leak; a real kill -9 drops it too
+        del tr1           # kill -9: trainer + dataset state is gone
+
+        # restart: fresh trainer recovers from the WAL, then the producer
+        # re-sends EVERYTHING with the same ids (tail from the start)
+        tr2 = _fresh_trainer(params)
+        assert not _run_until_crash(tr2, batches)
+        assert tr2.booster.model_to_string() == want_text, \
+            f"recovered model differs after crash at {point}"
+        assert tr2.dataset.num_data == want_rows
+        # zero lost, zero double-trained: every batch exactly once
+        seqs = tr2.wal.batch_seqs()
+        assert len(seqs) == len(batches), f"{point}: lost/extra batches"
+        assert len(set(seqs)) == len(seqs), f"{point}: duplicate batches"
+        assert tr2.wal.committed_seq == tr2.wal.last_seq
+        assert tr2.recovery["committed"] + tr2.recovery["replayed"] > 0
+        st = tr2.statusz()
+        assert st["wal"]["batches"] == len(batches)
+        tr2.close()
+
+
+def test_recovery_without_refeed_resumes_pending(tmp_path, monkeypatch):
+    """Even with no producer re-send, restart alone must finish the job:
+    pending batches replay through the trigger machinery on construction.
+    The crash lands at online_publish during the cycle the 3rd batch
+    triggers (30 rows = online_refit_rows), so exactly batches 0-2 are
+    durable — the reference is an uninterrupted run over those three."""
+    batches = _batches(6, rows=10)
+
+    base = tmp_path / "base2"
+    base.mkdir()
+    monkeypatch.chdir(base)
+    params = _params("wal")
+    trb = _fresh_trainer(params)
+    assert not _run_until_crash(trb, batches[:3])
+    want_text = trb.booster.model_to_string()
+    trb.close()
+
+    d = tmp_path / "crash"
+    d.mkdir()
+    monkeypatch.chdir(d)
+    faults.configure("online_publish:1")
+    tr1 = _fresh_trainer(params)
+    assert _run_until_crash(tr1, batches)
+    faults.reset()
+    assert tr1.wal.last_seq == 3   # the triggering batch was logged first
+    tr1.wal.close()
+    del tr1
+
+    tr2 = _fresh_trainer(params)   # recovery replays pending; cycles fire
+    assert tr2.cycles == 1         # the replayed 30 rows re-trigger
+    tr2.flush()
+    assert tr2.booster.model_to_string() == want_text
+    assert tr2.wal.committed_seq == tr2.wal.last_seq == 3
+    tr2.close()
+
+
+# ---- async refit: feed never blocks on training ----
+
+def test_async_feed_storm_and_freshness(tmp_path, monkeypatch):
+    obs.configure(enabled=True)
+    params = _params(tmp_path / "w", online_async_refit=True,
+                     online_refit_rows=16, online_boost_rounds=0,
+                     online_freshness_slo_s=1e-4)   # every cycle breaches
+    orig = OnlineTrainer._run_cycle
+
+    def slow_cycle(self, cyc):   # a deliberately slow training cycle
+        time.sleep(0.25)
+        return orig(self, cyc)
+
+    monkeypatch.setattr(OnlineTrainer, "_run_cycle", slow_cycle)
+    tr = _fresh_trainer(params)
+    try:
+        # warm the refit path (first cycle compiles) before timing anything
+        Xw, yw = _make_data(n=16, seed=123)
+        tr.feed(Xw, yw, batch_id="warm")
+        deadline = time.time() + 60
+        while tr.cycles < 1 and time.time() < deadline:
+            time.sleep(0.01)
+        assert tr.cycles >= 1
+
+        lat, errs = [], []
+        lat_lock = threading.Lock()
+
+        def feeder(t):
+            try:
+                rng = np.random.RandomState(100 + t)
+                for i in range(25):
+                    X = rng.rand(2, N_FEAT)
+                    t0 = time.perf_counter()
+                    tr.feed(X, X[:, 0], batch_id=f"t{t}-{i}")
+                    dt = time.perf_counter() - t0
+                    with lat_lock:
+                        lat.append(dt)
+            except Exception as e:   # pragma: no cover
+                errs.append(e)
+
+        ths = [threading.Thread(target=feeder, args=(t,)) for t in range(8)]
+        [t.start() for t in ths]
+        [t.join() for t in ths]
+        assert not errs, errs
+        assert len(lat) == 200
+        # every cycle takes >= 0.25s; a feed that waited for one would show
+        # it. Queue handoff + WAL fsync is all a feed is allowed to cost.
+        assert max(lat) < 0.2, f"feed blocked on training: {max(lat):.3f}s"
+        tr.flush()     # drains synchronously through the cycle lock
+        assert tr.pending_rows == 0
+        assert tr.cycles >= 2
+        # exactly-once held under the storm: 201 unique durable batches
+        seqs = tr.wal.batch_seqs()
+        assert len(seqs) == 201 and len(set(seqs)) == 201
+        assert tr.wal.committed_seq == tr.wal.last_seq
+        # freshness SLO plane: gauges exported, breaches counted
+        snap = obs.slo.FRESHNESS.snapshot()["default"]
+        assert snap["cycles"] == tr.cycles and snap["breaches"] >= 1
+        mets = obs.METRICS.to_json()
+        assert "refit_lag_seconds" in mets
+        assert "refit_cycles" in mets and "freshness_violations" in mets
+        obs.run_collectors()   # the trainer's pending-lag collector
+        assert "refit_pending_lag_seconds" in obs.METRICS.to_json()
+        st = tr.statusz()
+        assert st["async"] and st["freshness"]["cycles"] == tr.cycles
+    finally:
+        tr.close()
+    assert tr.wal.closed
+
+
+def test_failed_cycle_keeps_last_good(tmp_path, monkeypatch):
+    flight_dir = tmp_path / "flight"
+    flight_dir.mkdir()
+    obs.configure(enabled=True)
+    monkeypatch.setattr(OnlineTrainer, "RETRY_BACKOFF_S", 0.4)
+    # telemetry + flight_dir ride in the params: the cycle's engine.train
+    # call re-applies the config's telemetry knobs (configure_from_config)
+    params = _params(tmp_path / "w", online_async_refit=True,
+                     online_refit_rows=10, telemetry=True,
+                     flight_dir=str(flight_dir))
+    tr = _fresh_trainer(params)
+    try:
+        last_good = tr.booster.model_to_string()
+        faults.configure("online_train:1")   # first cycle attempt dies
+        X, y = _make_data(n=10, seed=9)
+        assert tr.feed(X, y, batch_id="fail-batch") is None
+        deadline = time.time() + 30
+        while tr.failures < 1 and time.time() < deadline:
+            time.sleep(0.01)
+        assert tr.failures == 1
+        # inside the backoff window: last-good keeps serving, bit-exactly,
+        # and feeding still works (never blocked by the broken cycle)
+        assert tr.cycles == 0
+        assert tr.booster.model_to_string() == last_good
+        st = tr.statusz()
+        assert st["failures"] == 1 and "FaultInjected" in st["last_error"]
+        # the failure event tripped the flight recorder
+        events = obs.EVENTS.snapshot()
+        fails = [e for e in events if e["type"] == "online_cycle_failed"]
+        assert fails and fails[-1]["trigger"] == "rows"
+        assert fails[-1]["attempt"] == 1
+        assert fails[-1]["error_class"] == "FaultInjected"
+        dumps = glob.glob(str(flight_dir / "flight_*online_cycle_failed*"))
+        assert dumps, os.listdir(str(flight_dir))
+        # the retry (fault exhausted) completes the SAME snapshot: rows
+        # trained exactly once, model publishes, WAL commits
+        deadline = time.time() + 60
+        while tr.cycles < 1 and time.time() < deadline:
+            time.sleep(0.01)
+        assert tr.cycles == 1 and tr.failures == 1
+        assert tr.dataset.num_data == 130   # 120 base + the 10 fed, once
+        assert tr.wal.committed_seq == tr.wal.last_seq == 1
+        refits = [e for e in obs.EVENTS.snapshot()
+                  if e["type"] == "online_refit"]
+        assert refits and refits[-1]["attempt"] == 2
+        assert tr.booster.model_to_string() != last_good
+    finally:
+        faults.reset()
+        tr.close()
+
+
+# ---- bounded sliding-window datasets ----
+
+def test_eviction_window_bit_exact_flat():
+    X, y = _make_data(n=300, f=6, seed=31)
+    w = np.linspace(0.5, 1.5, 300)
+    params = {"objective": "regression", "num_leaves": 7, "verbose": -1,
+              "min_data_in_leaf": 5, "max_bin": 63}
+    ds = Dataset(X[:100], label=y[:100], weight=w[:100], params=params)
+    ds.construct()
+    # grow past the cap: 100 + 80 = 180 -> keep the newest 120
+    ds.append(X[100:180], label=y[100:180], weight=w[100:180], max_rows=120)
+    assert ds.num_data == 120
+    ref = Dataset(X[60:180], label=y[60:180], weight=w[60:180],
+                  params=params, reference=ds)
+    ref.construct()
+    assert np.array_equal(np.asarray(ds.bins[:120]),
+                          np.asarray(ref.bins[:120]))
+    np.testing.assert_array_equal(ds.get_label(),
+                                  y[60:180].astype(np.float32))
+    np.testing.assert_array_equal(ds.get_weight(),
+                                  w[60:180].astype(np.float32))
+    # a from-scratch train over the window is byte-identical
+    ma = lgb.train(params, ds, num_boost_round=3)
+    mb = lgb.train(params, ref, num_boost_round=3)
+    assert ma.model_to_string() == mb.model_to_string()
+    # one append larger than the whole remaining window: only the newest
+    # cap rows of the incoming chunk survive
+    ds.append(X[180:300], label=y[180:300], weight=w[180:300], max_rows=120)
+    assert ds.num_data == 120
+    ref2 = Dataset(X[180:300], label=y[180:300], weight=w[180:300],
+                   params=params, reference=ds)
+    ref2.construct()
+    assert np.array_equal(np.asarray(ds.bins[:120]),
+                          np.asarray(ref2.bins[:120]))
+    np.testing.assert_array_equal(ds.get_label(),
+                                  y[180:300].astype(np.float32))
+
+
+def test_eviction_window_bit_exact_sharded():
+    X, y = _make_data(n=260, f=6, seed=32)
+    params = {"objective": "regression", "num_leaves": 7, "verbose": -1,
+              "min_data_in_leaf": 5, "num_shards": 4}
+    ds = Dataset(X[:101], label=y[:101], params=params)   # non-divisible
+    ds.construct()
+    ds.append(X[101:180], label=y[101:180], max_rows=96)
+    assert ds.num_data == 96
+    plan = ds.shard_plan
+    assert plan is not None and plan.num_shards == 4 and plan.n_rows == 96
+    assert len(set(ds.bins.sharding.device_set)) == 4
+    ref = Dataset(X[84:180], label=y[84:180], params=params, reference=ds)
+    ref.construct()
+    assert np.array_equal(np.asarray(ds.bins[:96]),
+                          np.asarray(ref.bins[:96]))
+    ma = lgb.train(params, ds, num_boost_round=3)
+    mb = lgb.train(params, ref, num_boost_round=3)
+    assert ma.model_to_string() == mb.model_to_string()
+
+
+def test_trainer_sliding_window_caps_dataset(tmp_path):
+    params = _params(tmp_path / "w", online_refit_rows=20,
+                     online_max_rows=150)
+    tr = _fresh_trainer(params)   # 120 base rows
+    try:
+        stream_X, stream_y = [], []
+        rng = np.random.RandomState(55)
+        for i in range(5):
+            X = rng.rand(20, N_FEAT)
+            y = X[:, 0] + 0.5 * X[:, 1]
+            stream_X.append(X)
+            stream_y.append(y)
+            tr.feed(X, y, batch_id=f"s{i}")   # each batch triggers a cycle
+        assert tr.cycles == 5
+        assert tr.dataset.num_data == 150    # capped, not 220
+        # the window is the newest 150 rows of base+stream
+        X0, y0 = _make_data()
+        allX = np.concatenate([X0] + stream_X)
+        ally = np.concatenate([y0] + stream_y)
+        ref = Dataset(allX[-150:], label=ally[-150:], params=params,
+                      reference=tr.dataset)
+        ref.construct()
+        assert np.array_equal(np.asarray(tr.dataset.bins[:150]),
+                              np.asarray(ref.bins[:150]))
+        np.testing.assert_array_equal(tr.dataset.get_label(),
+                                      ally[-150:].astype(np.float32))
+    finally:
+        tr.close()
+
+
+def test_window_smaller_than_trigger_rejected():
+    with pytest.raises(LightGBMError, match="online_max_rows"):
+        params_to_config({"online_max_rows": 10, "online_refit_rows": 20})
+    conf = params_to_config({"online_max_rows": 0,
+                             "online_refit_rows": 20})
+    assert conf.online_max_rows == 0     # 0 = unbounded stays valid
+
+
+# ---- tail_source: partial lines, truncation, rotation, ids ----
+
+def test_tail_source_buffers_partial_lines(tmp_path):
+    path = str(tmp_path / "feed.csv")
+    fh = open(path, "w")
+    fh.write("1.0,0.1,0.2\n2.0,0.3,")   # second line torn mid-write
+    fh.flush()
+    gen = tail_source(path, follow=True)
+    try:
+        b = next(gen)
+        assert b is not None
+        np.testing.assert_array_equal(b[1], [1.0])   # line 1 only
+        assert next(gen) is None                     # caught up, tail held
+        fh.write("0.4\n")                            # the line completes
+        fh.flush()
+        b = next(gen)
+        assert b is not None
+        np.testing.assert_array_equal(b[0], [[0.3, 0.4]])
+        np.testing.assert_array_equal(b[1], [2.0])
+    finally:
+        gen.close()
+        fh.close()
+
+
+def test_tail_source_final_unterminated_line(tmp_path):
+    path = str(tmp_path / "feed.csv")
+    with open(path, "w") as fh:
+        fh.write("1.0,0.1,0.2\n2.0,0.3,0.4")   # no trailing newline
+    batches = [b for b in tail_source(path, follow=False) if b is not None]
+    ys = np.concatenate([b[1] for b in batches])
+    np.testing.assert_array_equal(ys, [1.0, 2.0])
+
+
+def test_tail_source_detects_truncation_and_rotation(tmp_path):
+    path = str(tmp_path / "feed.csv")
+    with open(path, "w") as fh:
+        fh.write("1.0,0.1,0.2\n2.0,0.3,0.4\n")
+    gen = tail_source(path, follow=True)
+    try:
+        b = next(gen)
+        np.testing.assert_array_equal(b[1], [1.0, 2.0])
+        # truncation: the file shrank below the read position -> reopen
+        with open(path, "w") as fh:
+            fh.write("3.0,0.5,0.6\n")
+        b = next(gen)
+        assert b is not None
+        np.testing.assert_array_equal(b[1], [3.0])
+        # rotation: the path now names a different inode -> reopen at 0
+        os.replace(path, path + ".1")
+        with open(path, "w") as fh:
+            fh.write("4.0,0.7,0.8\n")
+        b = next(gen)
+        assert b is not None
+        np.testing.assert_array_equal(b[1], [4.0])
+    finally:
+        gen.close()
+
+
+def test_tail_source_ids_stable_across_chunking(tmp_path):
+    path = str(tmp_path / "feed.csv")
+    with open(path, "w") as fh:
+        fh.write("# header\n1.0,0.1,0.2\n2.0,0.3,0.4\n3.0,0.5,0.6\n")
+    whole = [b for b in tail_source(path, follow=False, with_ids=True)
+             if b is not None]
+    assert len(whole) == 3 and all(len(b) == 4 for b in whole)
+    ids_whole = [b[3] for b in whole]
+    assert len(set(ids_whole)) == 3
+    # a second pass (a restarted producer) derives the SAME ids
+    again = [b[3] for b in tail_source(path, follow=False, with_ids=True)
+             if b is not None]
+    assert again == ids_whole
+
+
+def test_producer_restart_dedups_through_wal(tmp_path):
+    path = str(tmp_path / "feed.csv")
+    rng = np.random.RandomState(3)
+    with open(path, "w") as fh:
+        for _ in range(5):
+            v = rng.rand(N_FEAT + 1)
+            fh.write(",".join("%.17g" % x for x in v) + "\n")
+    params = _params(tmp_path / "w", online_refit_rows=3,
+                     num_iterations=2, online_boost_rounds=1)
+    tr1 = _fresh_trainer(params)
+    fed = tr1.run(tail_source(path, follow=False, with_ids=True))
+    assert fed == 5
+    assert tr1.wal.committed_seq == tr1.wal.last_seq == 5
+    text1 = tr1.booster.model_to_string()
+    tr1.close()
+    # restart both halves: trainer recovers, producer re-reads from the
+    # start — every re-sent batch is already in the log and drops
+    tr2 = _fresh_trainer(params)
+    fed2 = tr2.run(tail_source(path, follow=False, with_ids=True))
+    assert fed2 == 5                       # offered again...
+    assert len(tr2.wal.batch_seqs()) == 5  # ...but logged exactly once
+    assert tr2.booster.model_to_string() == text1
+    tr2.close()

@@ -1593,3 +1593,148 @@ def test_every_rule_has_fixture_and_doc_row():
             fired = names(analyze_source(src, rules=[name], **kwargs))
             assert name in fired, \
                 f"fixture {fixture_name} no longer fires {name}"
+
+# ---- write-ahead feed log rule scopes (PR: exactly-once online training) ----
+# wal.py joins the shared-state scope (serve-handler threads append while the
+# refit worker commits), online.py's _worker_loop joins the scheduler-loop
+# audit (it drains the bounded trigger queue), and wal.py's append-mode log
+# handle is NOT exempt from the atomic-write rule — the shipped open("ab")
+# carries an inline suppression whose justification is the record framing +
+# truncate-on-recovery protocol, and these fixtures keep that the only way in.
+
+WAL_REL = "lightgbm_tpu/wal.py"
+
+WAL_SHARED_BAD = """
+_OPEN_LOGS = {}
+
+def register_log(path, fh):
+    _OPEN_LOGS[path] = fh
+"""
+
+WAL_SHARED_SUPPRESSED = """
+_OPEN_LOGS = {}
+
+def register_log(path, fh):
+    # single-writer by contract: one FeedLog per trainer, opened in __init__
+    _OPEN_LOGS[path] = fh   # tpu-lint: disable=unlocked-shared-state
+"""
+
+WAL_SHARED_LOCKED = """
+import threading
+_OPEN_LOGS = {}
+_LOCK = threading.Lock()
+
+def register_log(path, fh):
+    with _LOCK:
+        _OPEN_LOGS[path] = fh
+"""
+
+
+def test_wal_module_in_shared_state_scope():
+    assert "unlocked-shared-state" in names(
+        analyze_source(WAL_SHARED_BAD, relpath=WAL_REL))
+    assert "unlocked-shared-state" not in names(
+        analyze_source(WAL_SHARED_SUPPRESSED, relpath=WAL_REL))
+    kept = analyze_source(WAL_SHARED_SUPPRESSED, relpath=WAL_REL,
+                          keep_suppressed=True)
+    assert "unlocked-shared-state" in names(kept)
+    assert "unlocked-shared-state" not in names(
+        analyze_source(WAL_SHARED_LOCKED, relpath=WAL_REL))
+
+
+WORKER_LOOP_BAD = """
+import time
+
+def _worker_loop(self):
+    while True:
+        trigger = self._queue.get()
+        time.sleep(0.1)
+        self._worker.join()
+        self.refit_now(trigger=trigger)
+"""
+
+WORKER_LOOP_SUPPRESSED = """
+import time
+
+def _worker_loop(self):
+    while True:
+        trigger = self._queue.get(timeout=0.1)
+        # deterministic replay harness: the pause paces injected cycles
+        time.sleep(0.1)   # tpu-lint: disable=host-sync-in-jit
+        self.refit_now(trigger=trigger)
+"""
+
+WORKER_LOOP_CLEAN = """
+import queue
+
+def _worker_loop(self):
+    while True:
+        if self._stop.is_set():
+            return
+        try:
+            trigger = self._queue.get(timeout=0.1)
+        except queue.Empty:
+            continue
+        try:
+            self.refit_now(trigger=trigger)
+        except Exception:
+            if self._stop.wait(0.05):
+                return
+"""
+
+
+def test_refit_worker_loop_blocking_calls_fire():
+    found = analyze_source(WORKER_LOOP_BAD, relpath=ONLINE_REL)
+    assert "host-sync-in-jit" in names(found)
+    msgs = [f.message for f in found if f.rule == "host-sync-in-jit"]
+    assert any("sleep" in m for m in msgs), msgs
+    assert any(".join()" in m for m in msgs), msgs
+    assert any(".get()" in m for m in msgs), msgs
+    # _worker_loop elsewhere is not a designated scheduler loop
+    assert "host-sync-in-jit" not in names(
+        analyze_source(WORKER_LOOP_BAD, relpath="lightgbm_tpu/basic.py"))
+
+
+def test_refit_worker_loop_suppressed_and_clean():
+    assert "host-sync-in-jit" not in names(
+        analyze_source(WORKER_LOOP_SUPPRESSED, relpath=ONLINE_REL))
+    kept = analyze_source(WORKER_LOOP_SUPPRESSED, relpath=ONLINE_REL,
+                          keep_suppressed=True)
+    assert "host-sync-in-jit" in names(kept)
+    # the shipped idiom — timed get + stop-event wait, both bounded — is clean
+    assert "host-sync-in-jit" not in names(
+        analyze_source(WORKER_LOOP_CLEAN, relpath=ONLINE_REL))
+
+
+WAL_WRITE_BAD = """
+def append(self, rec):
+    fh = open(self.path, "ab")
+    fh.write(rec)
+"""
+
+WAL_WRITE_SUPPRESSED = """
+def open_log(self):
+    # append-only log: crash-safety is the framing + truncate-on-recovery
+    self._fh = open(self.path, "ab")  # tpu-lint: disable=non-atomic-artifact-write
+"""
+
+WAL_WRITE_CLEAN = """
+def scan(self):
+    with open(self.path, "rb") as fh:
+        return fh.read()
+"""
+
+
+def test_wal_append_write_needs_suppression():
+    # wal.py is NOT an exempt module like utils/atomic_io.py: a bare
+    # append-mode write there still fires, and the shipped handle must keep
+    # its justified inline suppression
+    assert "non-atomic-artifact-write" in names(
+        analyze_source(WAL_WRITE_BAD, relpath=WAL_REL))
+    assert "non-atomic-artifact-write" not in names(
+        analyze_source(WAL_WRITE_SUPPRESSED, relpath=WAL_REL))
+    kept = analyze_source(WAL_WRITE_SUPPRESSED, relpath=WAL_REL,
+                          keep_suppressed=True)
+    assert "non-atomic-artifact-write" in names(kept)
+    assert "non-atomic-artifact-write" not in names(
+        analyze_source(WAL_WRITE_CLEAN, relpath=WAL_REL))
