@@ -169,6 +169,11 @@ EVENT_SCHEMAS: Dict[str, Tuple[Dict[str, Any], Dict[str, Any]]] = {
     "wal_recover": ({"committed": int, "replayed": int},
                     {"rows": int, "truncated_bytes": int, "model": str,
                      "duration_s": _NUM}),
+    # a commit rotated the log: committed batch records outside the
+    # online_max_rows window were dropped (their ids carried forward in a
+    # tombstone record), bounding disk + recovery time for bounded-window
+    # trainers
+    "wal_rotate": ({"batches": int, "rows": int}, {"bytes": int}),
     # feed->publish freshness crossed online_freshness_slo_s (obs/slo.py
     # FreshnessTracker); emitted on both transitions like slo_breach
     "freshness_breach": ({"model": str, "lag_s": _NUM, "slo_s": _NUM},

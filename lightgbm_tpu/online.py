@@ -30,10 +30,14 @@ traffic. This module is that loop:
    refit model with zero dropped requests.
 
 Thread-safety: ``feed``/``flush`` may be called from any thread (the serve
-TCP handler threads do). Two locks split the trainer: ``_lock`` guards the
-cheap mutable state (pend buffers, booster pointer, version/cycle counters,
-drift baseline) and is only ever held briefly; ``_cycle_lock`` serializes
-refit cycles end-to-end. ``feed`` never takes ``_cycle_lock``, so with
+TCP handler threads do). Three locks split the trainer: ``_lock`` guards
+the cheap mutable state (pend buffers, booster pointer, version/cycle
+counters, drift baseline) and is only ever held briefly; ``_feed_lock``
+makes WAL sequence assignment + buffering one atomic step, so a cycle
+snapshot can never commit a sequence whose rows another feeder has not
+buffered yet (the exactly-once invariant: every commit covers exactly the
+batches at or below its sequence); ``_cycle_lock`` serializes refit cycles
+end-to-end. ``feed`` never takes ``_cycle_lock``, so with
 ``online_async_refit=1`` feeding never blocks on training: triggers hand off
 through a bounded queue to a dedicated worker thread (a full queue safely
 coalesces — any queued cycle snapshots ALL pending rows). A failed cycle
@@ -49,6 +53,7 @@ import os
 import queue
 import threading
 import time
+import zlib
 from typing import Any, Dict, List, Optional
 
 import numpy as np
@@ -119,10 +124,15 @@ def tail_source(path: str, stop: Optional[threading.Event] = None,
     ``with_ids=False`` (default) yields ``(X, y)`` with all complete rows
     read this poll batched together. ``with_ids=True`` yields one row per
     batch as ``(X, y, None, batch_id)`` where the id is derived from the
-    file's identity and the row's byte offset — stable across restarts and
-    independent of read chunking, so a restarted producer re-feeding from
-    the start is deduplicated by the trainer's WAL (exactly-once end to
-    end). Offsets assume the ASCII feeds the CLI convention produces.
+    file's identity, a signature of its leading bytes, and the row's byte
+    offset — stable across restarts and independent of read chunking, so a
+    restarted producer re-feeding from the start is deduplicated by the
+    trainer's WAL (exactly-once end to end). The content signature is what
+    keeps truncation honest: a copytruncate-style rotation reuses the
+    inode AND the old byte offsets, so identity+offset alone would make
+    ``wal.seen()`` silently drop every row of the rewritten file as a
+    duplicate — the rewritten content re-keys the ids instead. Offsets
+    assume the ASCII feeds the CLI convention produces.
 
     Yields ``None`` when caught up with the file (the consumer's run loop
     does the bounded waiting — this generator never sleeps), and returns
@@ -137,14 +147,26 @@ def tail_source(path: str, stop: Optional[threading.Event] = None,
             return None
         return [float(t) for t in ln.replace(",", " ").split()]
 
-    def _one(row, start: int, ino: int):
+    def _one(row, start: int, ino: int, sig: str):
         arr = np.asarray([row], dtype=np.float64)
-        bid = f"{os.path.basename(path)}:{ino}:{start}"
+        bid = f"{os.path.basename(path)}:{ino}:{sig}:{start}"
         return arr[:, 1:], arr[:, 0], None, bid
+
+    def _filesig(f) -> str:
+        # signature of the file's first bytes: pure function of current
+        # content, so it is stable across tailer restarts but re-keys ids
+        # when a truncated file (same inode, same offsets) is rewritten
+        pos = f.tell()
+        f.seek(0)
+        head = f.read(64)
+        f.seek(pos)
+        return format(zlib.crc32(head.encode("utf-8", "replace"))
+                      & 0xFFFFFFFF, "08x")
 
     fh = open(path, "r")
     try:
         ino = os.fstat(fh.fileno()).st_ino
+        sig = None  # computed lazily, once content exists this generation
         if not from_start:
             fh.seek(0, 2)
         buf = ""
@@ -161,7 +183,9 @@ def tail_source(path: str, stop: Optional[threading.Event] = None,
                         off += len(ln) + 1
                         row = _parse(ln)
                         if row is not None:
-                            yield _one(row, start, ino)
+                            if sig is None:
+                                sig = _filesig(fh)
+                            yield _one(row, start, ino, sig)
                 else:
                     rows = []
                     for ln in lines:
@@ -185,6 +209,7 @@ def tail_source(path: str, stop: Optional[threading.Event] = None,
                 fh.close()
                 fh = open(path, "r")
                 ino = os.fstat(fh.fileno()).st_ino
+                sig = None  # new generation: ids re-key on the new content
                 buf = ""
                 off = 0
                 continue
@@ -193,7 +218,9 @@ def tail_source(path: str, stop: Optional[threading.Event] = None,
                     row = _parse(buf)
                     if row is not None:
                         if with_ids:
-                            yield _one(row, off, ino)
+                            if sig is None:
+                                sig = _filesig(fh)
+                            yield _one(row, off, ino, sig)
                         else:
                             arr = np.asarray([row], dtype=np.float64)
                             yield arr[:, 1:], arr[:, 0]
@@ -263,6 +290,9 @@ class OnlineTrainer:
             (server.registry if server is not None else None)
         self.name = name
         self._lock = threading.RLock()
+        # serializes WAL seq assignment + buffering (one atomic step: see
+        # feed()); never held across a training cycle
+        self._feed_lock = threading.Lock()
         self._pend_x: List[np.ndarray] = []
         self._pend_y: List[np.ndarray] = []
         self._pend_w: List[np.ndarray] = []
@@ -295,7 +325,11 @@ class OnlineTrainer:
         if self.conf.online_wal:
             wal_dir = self.conf.online_wal_dir or os.path.join(
                 os.path.dirname(self.conf.output_model) or ".", "online_wal")
-            self.wal = FeedLog(wal_dir)
+            # keep_rows = the sliding window: with online_max_rows set the
+            # log rotates committed records the rebuilt dataset can never
+            # contain, bounding disk and recovery time
+            self.wal = FeedLog(wal_dir,
+                               keep_rows=self.conf.online_max_rows or 0)
             lc = self.wal.last_commit
             if lc and lc.get("model"):
                 mpath = os.path.join(self.wal.dir, str(lc["model"]))
@@ -449,6 +483,9 @@ class OnlineTrainer:
             self._buffer(b.X, b.y, b.w, seq=b.seq)
             replayed += 1
             rows += b.rows
+        # the scan-loaded committed payloads are now re-appended into the
+        # dataset; drop them from memory (the disk log keeps them)
+        self.wal.release_committed()
         dur = time.time() - t0
         self.recovery = {"committed": len(committed),
                          "replayed": int(replayed), "rows": int(rows),
@@ -478,18 +515,31 @@ class OnlineTrainer:
             log.fatal(f"feed: {X.shape[0]} rows but {y.shape[0]} labels")
         w = None if weight is None else \
             np.asarray(weight, dtype=np.float64).reshape(-1)
-        seq = 0
-        if self.wal is not None:
+        if self.wal is None:
+            return self._dispatch(self._buffer_rows(X, y, w, 0), X, y, w)
+        # seq assignment and buffering are ONE atomic step under _feed_lock:
+        # without it thread B could buffer seq N+1 before thread A buffers
+        # seq N, a cycle snapshot taken in that gap would commit through
+        # N+1 with N's rows still unbuffered, and recovery after a crash
+        # would classify batch N as already trained — silently losing it
+        with self._feed_lock:
             if batch_id is not None and self.wal.seen(batch_id):
                 return None
             try:
                 seq = self.wal.append_batch(X, y, w, batch_id=batch_id)
             except ValueError:
                 return None  # duplicate id raced in from another thread
-        return self._buffer(X, y, w, seq=seq)
+            trigger = self._buffer_rows(X, y, w, seq)
+        return self._dispatch(trigger, X, y, w)
 
     def _buffer(self, X, y, w, seq: int = 0) -> Optional[int]:
-        trigger = None
+        # recovery replay path (single-threaded, in __init__): buffer and
+        # run the same trigger machinery a live feed would
+        return self._dispatch(self._buffer_rows(X, y, w, seq), X, y, w)
+
+    def _buffer_rows(self, X, y, w, seq: int) -> Optional[str]:
+        """Insert one batch into the pending buffers; returns the row-count
+        trigger if this batch crossed ``online_refit_rows``."""
         with self._lock:
             self._pend_x.append(X)
             self._pend_y.append(y)
@@ -501,7 +551,13 @@ class OnlineTrainer:
             if self._pend_oldest_ts is None:
                 self._pend_oldest_ts = time.time()
             if self.pending_rows >= self.conf.online_refit_rows:
-                trigger = "rows"
+                return "rows"
+        return None
+
+    def _dispatch(self, trigger: Optional[str], X, y, w) -> Optional[int]:
+        """Run the drift check and fire the triggered cycle (queue handoff
+        in async mode, inline otherwise). Outside ``_feed_lock`` — a
+        synchronous cycle must never stall the other feeders."""
         if trigger is None:
             trigger = self._check_drift(X, y, w)
         if trigger is not None:
@@ -676,7 +732,12 @@ class OnlineTrainer:
         the WAL. Idempotent; don't feed the trainer afterwards."""
         if self._worker is not None:
             self._stop.set()
-            self._worker.join(timeout=10.0)
+            # no timeout: an in-flight cycle (training can exceed any fixed
+            # bound) must finish its WAL commit and booster swap before the
+            # log handle below closes underneath it — a timed join would
+            # strand the worker writing into a closed fd and could publish
+            # a version whose commit record never lands
+            self._worker.join()
             self._worker = None
         if self._collector_name:
             obs.remove_collector(self._collector_name)

@@ -34,10 +34,30 @@ a batch trains.
 
 The log itself is an append-only file, NOT an atomic-replace artifact: its
 crash-safety comes from the framing + truncate-on-recovery protocol above,
-which is why the one ``open(path, "ab")`` below carries a tpu-lint
-suppression instead of routing through ``utils/atomic_io`` (whole-file
+which is why the ``open(path, "ab")`` handles below carry tpu-lint
+suppressions instead of routing through ``utils/atomic_io`` (whole-file
 replace would defeat the point of a log). Model artifacts referenced by
 commit records DO go through the atomic writer (``Booster.save_model``).
+
+A long-running trainer must not accumulate state without bound, so a
+commit also *releases* and (window mode) *rotates*:
+
+- **release**: committed batches drop their in-memory payload arrays —
+  the on-disk log is the source of truth at recovery, and every live
+  reader (``seen``, ``batch_seqs``, ``stats``) only needs the
+  seq/rows/id stubs. Resident payloads are bounded by the pending set.
+- **rotate** (``keep_rows > 0``, i.e. the trainer runs a bounded
+  ``online_max_rows`` window): once the committed prefix OUTSIDE the
+  newest ``keep_rows`` committed rows itself exceeds a window, the log is
+  rewritten — dropped batch records are replaced by one ids record that
+  carries their batch ids forward (a producer re-send of a rotated batch
+  still deduplicates), retained batch frames are copied verbatim, and
+  only the latest commit record survives. The rewrite goes through
+  ``utils/atomic_io`` (tmp + fsync + rename), so a crash mid-rotation
+  leaves either the old log or the new one, never a torn mix. Disk and
+  recovery-replay time stay O(window + pending). With ``keep_rows == 0``
+  (unbounded dataset) the log is never rotated: recovery needs every
+  committed row to rebuild the dataset.
 """
 from __future__ import annotations
 
@@ -50,7 +70,7 @@ from typing import Any, Dict, List, Optional
 
 import numpy as np
 
-from .utils import faults, log
+from .utils import atomic_io, faults, log
 
 LOG_NAME = "feed.wal"
 
@@ -61,12 +81,52 @@ _MAGIC = b"LGWL"
 _FRAME = struct.Struct("<4sBQII")
 _KIND_BATCH = 1
 _KIND_COMMIT = 2
+# rotation tombstone: the ids (and counts) of batch records dropped by log
+# rotation, carried forward so producer re-sends of rotated batches still
+# deduplicate after a restart
+_KIND_IDS = 3
+
+
+def _encode_record(kind: int, seq: int, header: Dict[str, Any],
+                   payload: bytes = b"") -> bytes:
+    hb = json.dumps(header, sort_keys=True).encode("utf-8")
+    body = hb + payload
+    crc = zlib.crc32(body) & 0xFFFFFFFF
+    return _FRAME.pack(_MAGIC, kind, seq, len(hb), len(payload)) + \
+        struct.pack("<I", crc) + body
+
+
+def _scan_frames(blob: bytes):
+    """Yield ``(off, end, kind, seq, header, payload)`` for every valid
+    frame in ``blob``, stopping at the first torn/invalid byte (the
+    truncate-on-recovery resynchronization point)."""
+    off = 0
+    n = len(blob)
+    while off + _FRAME.size <= n:
+        magic, kind, seq, hlen, plen = _FRAME.unpack_from(blob, off)
+        end = off + _FRAME.size + 4 + hlen + plen
+        if magic != _MAGIC or end > n:
+            return
+        (crc,) = struct.unpack_from("<I", blob, off + _FRAME.size)
+        body = blob[off + _FRAME.size + 4:end]
+        if zlib.crc32(body) & 0xFFFFFFFF != crc:
+            return
+        try:
+            header = json.loads(body[:hlen].decode("utf-8"))
+        except ValueError:
+            return
+        yield off, end, kind, seq, header, body[hlen:]
+        off = end
 
 
 class WalBatch:
-    """One durable feed batch, decoded back to host arrays."""
+    """One durable feed batch, decoded back to host arrays.
 
-    __slots__ = ("seq", "X", "y", "w", "batch_id")
+    After its commit the payload arrays are released (:meth:`drop_payload`)
+    and only the ``seq``/``rows``/``batch_id`` stub stays resident — the
+    on-disk record keeps the bytes for recovery."""
+
+    __slots__ = ("seq", "X", "y", "w", "batch_id", "rows")
 
     def __init__(self, seq: int, X: np.ndarray, y: np.ndarray,
                  w: Optional[np.ndarray], batch_id: Optional[str]):
@@ -75,10 +135,16 @@ class WalBatch:
         self.y = y
         self.w = w
         self.batch_id = batch_id
+        self.rows = int(y.shape[0])
+
+    def drop_payload(self) -> None:
+        self.X = None
+        self.y = None
+        self.w = None
 
     @property
-    def rows(self) -> int:
-        return int(self.y.shape[0])
+    def has_payload(self) -> bool:
+        return self.y is not None
 
 
 class FeedLog:
@@ -88,21 +154,32 @@ class FeedLog:
     commit recovered, next sequence number derived. All appends are fsync'd
     before returning — an ``append_batch`` that returned has survived the
     process by definition.
+
+    ``keep_rows`` is the trainer's ``online_max_rows`` window: with it set,
+    commits rotate the log so disk never holds much more than the newest
+    ``keep_rows`` committed rows plus the pending batches (see the module
+    docstring); 0 keeps every committed record (an unbounded dataset needs
+    them all to rebuild).
     """
 
-    def __init__(self, wal_dir: str):
+    def __init__(self, wal_dir: str, keep_rows: int = 0):
         self.dir = str(wal_dir)
         os.makedirs(self.dir, exist_ok=True)
         self.path = os.path.join(self.dir, LOG_NAME)
         self._lock = threading.Lock()
+        self._keep_rows = int(keep_rows or 0)
         self._batches: List[WalBatch] = []
         self._ids: set = set()
+        self._rotated_ids: set = set()
         self._last_commit: Optional[Dict[str, Any]] = None
         self._last_seq = 0
         self._committed_seq = 0
         self.truncated_bytes = 0
         self.appends = 0
         self.commits = 0
+        self.rotations = 0
+        self.rotated_batches = 0
+        self.rotated_rows = 0
         self._scan()
         # append-only log handle: crash-safety comes from the record framing
         # + truncate-on-recovery scan above, not from atomic replace — this
@@ -115,31 +192,24 @@ class FeedLog:
             return
         with open(self.path, "rb") as fh:
             blob = fh.read()
-        off = 0
         good = 0
         n = len(blob)
-        while off + _FRAME.size <= n:
-            magic, kind, seq, hlen, plen = _FRAME.unpack_from(blob, off)
-            end = off + _FRAME.size + 4 + hlen + plen
-            if magic != _MAGIC or end > n:
-                break
-            (crc,) = struct.unpack_from("<I", blob, off + _FRAME.size)
-            body = blob[off + _FRAME.size + 4:end]
-            if zlib.crc32(body) & 0xFFFFFFFF != crc:
-                break
-            try:
-                header = json.loads(body[:hlen].decode("utf-8"))
-            except ValueError:
-                break
+        for off, end, kind, seq, header, payload in _scan_frames(blob):
             if kind == _KIND_BATCH:
-                self._ingest_batch(seq, header, body[hlen:])
+                self._ingest_batch(seq, header, payload)
             elif kind == _KIND_COMMIT:
                 self._committed_seq = max(self._committed_seq, int(seq))
                 self._last_commit = header
                 self.commits += 1
+            elif kind == _KIND_IDS:
+                ids = [str(i) for i in header.get("ids", [])]
+                self._rotated_ids.update(ids)
+                self._ids.update(ids)
+                # totals, not deltas: each rotation rewrites the one record
+                self.rotated_batches = int(header.get("batches", 0))
+                self.rotated_rows = int(header.get("rows", 0))
             self._last_seq = max(self._last_seq, int(seq))
             good = end
-            off = end
         if good < n:
             # torn tail from a crash mid-append: the partial record was
             # never acknowledged, so truncating it IS the recovery
@@ -174,11 +244,7 @@ class FeedLog:
     # ---- write path ----
     def _append_record(self, kind: int, seq: int, header: Dict[str, Any],
                        payload: bytes = b"") -> int:
-        hb = json.dumps(header, sort_keys=True).encode("utf-8")
-        body = hb + payload
-        crc = zlib.crc32(body) & 0xFFFFFFFF
-        rec = _FRAME.pack(_MAGIC, kind, seq, len(hb), len(payload)) + \
-            struct.pack("<I", crc) + body
+        rec = _encode_record(kind, seq, header, payload)
         self._fh.write(rec)
         self._fh.flush()
         os.fsync(self._fh.fileno())
@@ -240,9 +306,98 @@ class FeedLog:
             self._last_commit = header
             self._last_seq = max(self._last_seq, int(seq_through))
             self.commits += 1
+            self._release_committed_locked()
+            rotated = self._maybe_rotate_locked()
+            if model is not None:
+                self._gc_artifacts_locked(str(model))
         from . import obs
         obs.emit("wal_commit", seq=int(seq_through), version=int(version),
                  model=str(model) if model is not None else "")
+        if rotated is not None:
+            obs.emit("wal_rotate", batches=int(rotated["batches"]),
+                     rows=int(rotated["rows"]), bytes=int(rotated["bytes"]))
+
+    # ---- retention: payload release + log rotation ----
+    def _gc_artifacts_locked(self, keep: str) -> None:
+        """Unlink model artifacts superseded by the commit naming ``keep``:
+        recovery only ever loads the LATEST commit's artifact, so older
+        ``model_*.txt`` files are dead weight on disk. Crash-safe — a
+        half-finished sweep just leaves unused files for the next commit."""
+        for fn in os.listdir(self.dir):
+            if fn.startswith("model_") and fn.endswith(".txt") \
+                    and fn != keep:
+                try:
+                    os.unlink(os.path.join(self.dir, fn))
+                except OSError:
+                    pass
+
+    def release_committed(self) -> None:
+        """Drop the in-memory payload arrays of committed batches (their
+        seq/rows/id stubs stay for bookkeeping). Recovery re-reads payloads
+        from disk; resident memory is bounded by the pending set. Called by
+        every :meth:`commit`, and by the trainer once recovery has finished
+        re-appending the scan-loaded committed rows."""
+        with self._lock:
+            self._release_committed_locked()
+
+    def _release_committed_locked(self) -> None:
+        for b in self._batches:
+            if b.seq <= self._committed_seq and b.has_payload:
+                b.drop_payload()
+
+    def _maybe_rotate_locked(self) -> Optional[Dict[str, int]]:
+        if self._keep_rows <= 0:
+            return None   # unbounded dataset: every committed row rebuilds
+        # committed batches outside the newest keep_rows committed rows are
+        # droppable — recovery only re-appends the sliding window
+        kept = 0
+        drop_seqs = set()
+        drop_rows = 0
+        for b in reversed(self._batches):
+            if b.seq > self._committed_seq:
+                continue
+            if kept >= self._keep_rows:
+                drop_seqs.add(b.seq)
+                drop_rows += b.rows
+            else:
+                kept += b.rows
+        if drop_rows < self._keep_rows:
+            return None   # hysteresis: rewrite once a full window pends
+        return self._rotate_locked(drop_seqs)
+
+    def _rotate_locked(self, drop_seqs: set) -> Dict[str, int]:
+        dropped = [b for b in self._batches if b.seq in drop_seqs]
+        self._rotated_ids.update(b.batch_id for b in dropped
+                                 if b.batch_id is not None)
+        self.rotated_batches += len(dropped)
+        self.rotated_rows += sum(b.rows for b in dropped)
+        with open(self.path, "rb") as fh:
+            blob = fh.read()
+        frames: List[bytes] = []
+        commit_frame = b""
+        for off, end, kind, seq, _header, _payload in _scan_frames(blob):
+            if kind == _KIND_COMMIT:
+                commit_frame = blob[off:end]   # only the latest survives
+            elif kind == _KIND_BATCH and seq not in drop_seqs:
+                frames.append(blob[off:end])
+            # old ids records fold into the rewritten one below
+        ids_rec = _encode_record(
+            _KIND_IDS, int(self._committed_seq),
+            {"ids": sorted(self._rotated_ids),
+             "batches": int(self.rotated_batches),
+             "rows": int(self.rotated_rows)})
+        new_blob = b"".join([ids_rec] + frames + [commit_frame])
+        # the one whole-file rewrite the log ever does: atomic replace, so
+        # a crash mid-rotation leaves the old log or the new one intact
+        self._fh.close()
+        atomic_io.atomic_write_bytes(self.path, new_blob)
+        # append-only log handle, same contract as __init__
+        self._fh = open(self.path, "ab")  # tpu-lint: disable=non-atomic-artifact-write
+        self._batches = [b for b in self._batches if b.seq not in drop_seqs]
+        self.rotations += 1
+        return {"batches": len(dropped),
+                "rows": sum(b.rows for b in dropped),
+                "bytes": len(blob) - len(new_blob)}
 
     # ---- recovery surface (read by OnlineTrainer.__init__) ----
     @property
@@ -266,7 +421,10 @@ class FeedLog:
 
     def committed(self) -> List[WalBatch]:
         """Batches already trained into the committed model artifact, in
-        sequence order: re-append their rows, never retrain them."""
+        sequence order: re-append their rows, never retrain them. Payloads
+        are present right after a scan (the recovery window) and released
+        once a commit — or the trainer's post-recovery
+        :meth:`release_committed` — seals them."""
         with self._lock:
             return [b for b in self._batches if b.seq <= self._committed_seq]
 
@@ -299,7 +457,12 @@ class FeedLog:
                     "commits": int(self.commits),
                     "last_seq": int(self._last_seq),
                     "committed_seq": int(self._committed_seq),
-                    "truncated_bytes": int(self.truncated_bytes)}
+                    "truncated_bytes": int(self.truncated_bytes),
+                    "resident_batches": sum(
+                        1 for b in self._batches if b.has_payload),
+                    "rotations": int(self.rotations),
+                    "rotated_batches": int(self.rotated_batches),
+                    "rotated_rows": int(self.rotated_rows)}
 
     def close(self) -> None:
         with self._lock:

@@ -336,6 +336,9 @@ def test_failed_cycle_keeps_last_good(tmp_path, monkeypatch):
     flight_dir = tmp_path / "flight"
     flight_dir.mkdir()
     obs.configure(enabled=True)
+    # another test may have tripped the process-global recorder <1s ago;
+    # this test asserts dump-on-trip, not the debounce, so disable it
+    monkeypatch.setattr(obs.flight, "_TRIP_DEBOUNCE_S", 0.0)
     monkeypatch.setattr(OnlineTrainer, "RETRY_BACKOFF_S", 0.4)
     # telemetry + flight_dir ride in the params: the cycle's engine.train
     # call re-applies the config's telemetry knobs (configure_from_config)
@@ -381,6 +384,188 @@ def test_failed_cycle_keeps_last_good(tmp_path, monkeypatch):
     finally:
         faults.reset()
         tr.close()
+
+
+# ---- exactly-once under concurrent feeders ----
+
+def test_concurrent_feed_cannot_commit_unbuffered_seq(tmp_path, monkeypatch):
+    """Seq assignment + buffering are one atomic step: while a feeder is
+    parked inside the WAL append (seq durable, rows not yet buffered), no
+    other feeder may buffer a later seq and no cycle may snapshot — a
+    commit through the later seq would make recovery classify the parked
+    batch as already trained, silently losing it."""
+    params = _params(tmp_path / "w", online_refit_rows=10_000)
+    tr = _fresh_trainer(params)
+    in_wal, release = threading.Event(), threading.Event()
+    orig = FeedLog.append_batch
+
+    def parked_append(self, X, y, w=None, batch_id=None):
+        seq = orig(self, X, y, w, batch_id=batch_id)
+        if batch_id == "parked":
+            in_wal.set()
+            release.wait(10)
+        return seq
+
+    monkeypatch.setattr(FeedLog, "append_batch", parked_append)
+    Xa, ya = _make_data(n=4, seed=1)
+    Xb, yb = _make_data(n=4, seed=2)
+    ta = threading.Thread(target=tr.feed, args=(Xa, ya),
+                          kwargs={"batch_id": "parked"})
+    tb = threading.Thread(target=tr.feed, args=(Xb, yb),
+                          kwargs={"batch_id": "other"})
+    try:
+        ta.start()
+        assert in_wal.wait(10)
+        tb.start()
+        tb.join(timeout=0.3)
+        assert tb.is_alive()            # serialized behind the feed lock
+        # the race window: seq 1 durable but unbuffered — a cycle here
+        # must find nothing to snapshot and nothing to commit
+        assert tr.pending_rows == 0
+        assert tr.refit_now() is None
+        assert tr.wal.committed_seq == 0
+    finally:
+        release.set()
+        ta.join()
+        tb.join()
+    assert tr.pending_rows == 8
+    tr.flush()
+    assert tr.wal.committed_seq == tr.wal.last_seq == 2
+    assert sorted(tr.wal.batch_seqs()) == [1, 2]
+    tr.close()
+
+
+# ---- WAL retention: payload release, log rotation, artifact GC ----
+
+def test_wal_release_and_rotation_bound_log(tmp_path):
+    fl = FeedLog(str(tmp_path / "w"), keep_rows=20)
+    rng = np.random.RandomState(0)
+    seq = 0
+    for i in range(10):
+        X = rng.rand(10, N_FEAT)
+        seq = fl.append_batch(X, X[:, 0], batch_id=f"r{i}")
+    size_before = os.path.getsize(fl.path)
+    fl.commit(seq, version=1)
+    st = fl.stats()
+    # committed payloads released from memory...
+    assert st["resident_batches"] == 0
+    # ...and the committed prefix outside the 20-row window rotated away
+    # (newest two 10-row batches retained, eight batches = 80 rows dropped)
+    assert st["rotations"] == 1
+    assert st["rotated_batches"] == 8 and st["rotated_rows"] == 80
+    assert st["batches"] == 2
+    assert os.path.getsize(fl.path) < size_before
+    fl.close()
+    # reopen: retained frames + the ids tombstone reconstruct the state
+    fl2 = FeedLog(str(tmp_path / "w"), keep_rows=20)
+    assert fl2.last_seq == 10 and fl2.committed_seq == 10
+    assert [b.seq for b in fl2.committed()] == [9, 10]
+    assert sum(b.rows for b in fl2.committed()) == 20
+    # rotated batch ids still deduplicate a producer re-send
+    assert fl2.seen("r0") and fl2.seen("r7") and fl2.seen("r9")
+    with pytest.raises(ValueError):
+        fl2.append_batch(rng.rand(10, N_FEAT), np.zeros(10), batch_id="r0")
+    # sequence numbering continues past the rotated prefix
+    assert fl2.append_batch(rng.rand(2, N_FEAT), np.zeros(2)) == 11
+    st2 = fl2.stats()
+    assert st2["rotated_batches"] == 8 and st2["rotated_rows"] == 80
+    fl2.close()
+
+
+def test_wal_unbounded_mode_releases_memory_keeps_disk(tmp_path):
+    fl = FeedLog(str(tmp_path / "w"))    # keep_rows=0: no rotation
+    rng = np.random.RandomState(1)
+    for i in range(5):
+        fl.append_batch(rng.rand(10, N_FEAT), np.zeros(10))
+    fl.commit(5, version=1)
+    st = fl.stats()
+    assert st["resident_batches"] == 0   # RAM bounded by the pending set
+    assert st["rotations"] == 0 and st["batches"] == 5
+    fl.close()
+    fl2 = FeedLog(str(tmp_path / "w"))   # every committed row still on disk
+    assert sum(b.rows for b in fl2.committed()) == 50
+    assert all(b.has_payload for b in fl2.committed())
+    fl2.close()
+
+
+def test_wal_commit_gcs_stale_model_artifacts(tmp_path):
+    fl = FeedLog(str(tmp_path / "w"))
+    rng = np.random.RandomState(2)
+    for seq in (1, 2):
+        fl.append_batch(rng.rand(5, N_FEAT), np.zeros(5))
+        with open(fl.model_artifact(seq), "w") as fh:
+            fh.write(f"model {seq}\n")
+        fl.commit(seq, version=seq,
+                  model=os.path.basename(fl.model_artifact(seq)))
+    left = sorted(fn for fn in os.listdir(fl.dir)
+                  if fn.startswith("model_"))
+    assert left == ["model_00000002.txt"]   # only the incumbent survives
+    fl.close()
+
+
+def test_trainer_rotation_recovery_window(tmp_path, monkeypatch):
+    """Restart over a rotated log: the retained window rebuilds the same
+    bounded dataset and the committed artifact is the same model."""
+    base = tmp_path / "b"
+    base.mkdir()
+    monkeypatch.chdir(base)
+    params = _params("wal", online_refit_rows=20, online_max_rows=40)
+    tr = _fresh_trainer(params)
+    stream_X, stream_y = [], []
+    rng = np.random.RandomState(7)
+    for i in range(5):
+        X = rng.rand(20, N_FEAT)
+        y = X[:, 0] + 0.5 * X[:, 1]
+        stream_X.append(X)
+        stream_y.append(y)
+        tr.feed(X, y, batch_id=f"s{i}")
+    assert tr.cycles == 5 and tr.dataset.num_data == 40
+    assert tr.wal.stats()["rotations"] >= 1
+    text = tr.booster.model_to_string()
+    tr.wal.close()
+    del tr
+    tr2 = _fresh_trainer(params)
+    try:
+        assert tr2.booster.model_to_string() == text
+        assert tr2.dataset.num_data == 40
+        X0, y0 = _make_data()
+        allX = np.concatenate([X0] + stream_X)
+        ally = np.concatenate([y0] + stream_y)
+        ref = Dataset(allX[-40:], label=ally[-40:], params=params,
+                      reference=tr2.dataset)
+        ref.construct()
+        assert np.array_equal(np.asarray(tr2.dataset.bins[:40]),
+                              np.asarray(ref.bins[:40]))
+        np.testing.assert_array_equal(tr2.dataset.get_label(),
+                                      ally[-40:].astype(np.float32))
+    finally:
+        tr2.close()
+
+
+# ---- close() drains the in-flight cycle before the WAL closes ----
+
+def test_close_drains_inflight_cycle_before_wal_close(tmp_path, monkeypatch):
+    params = _params(tmp_path / "w", online_async_refit=True,
+                     online_refit_rows=10)
+    started = threading.Event()
+    orig = OnlineTrainer._run_cycle
+
+    def slow_cycle(self, cyc):
+        started.set()
+        time.sleep(0.4)
+        return orig(self, cyc)
+
+    monkeypatch.setattr(OnlineTrainer, "_run_cycle", slow_cycle)
+    tr = _fresh_trainer(params)
+    X, y = _make_data(n=10, seed=11)
+    tr.feed(X, y, batch_id="one")
+    assert started.wait(10)
+    # close mid-cycle: the worker must finish — commit record landed in the
+    # still-open WAL, booster swapped — before the log handle closes
+    tr.close()
+    assert tr._worker is None and tr.wal.closed
+    assert tr.cycles == 1
+    assert tr.wal.committed_seq == tr.wal.last_seq == 1
 
 
 # ---- bounded sliding-window datasets ----
@@ -550,6 +735,29 @@ def test_tail_source_ids_stable_across_chunking(tmp_path):
     again = [b[3] for b in tail_source(path, follow=False, with_ids=True)
              if b is not None]
     assert again == ids_whole
+
+
+def test_tail_source_truncation_rekeys_ids(tmp_path):
+    """A copytruncate-style rotation reuses the inode AND the old byte
+    offsets; without the content signature the rewritten file's rows would
+    inherit the old rows' ids and wal.seen() would silently drop all the
+    new data as duplicates."""
+    path = str(tmp_path / "feed.csv")
+    with open(path, "w") as fh:
+        fh.write("1.0,0.1,0.2\n2.0,0.3,0.4\n")
+    gen = tail_source(path, follow=True, with_ids=True)
+    try:
+        first = [next(gen)[3], next(gen)[3]]
+        assert next(gen) is None           # caught up, holding the inode
+        with open(path, "w") as fh:        # truncate + rewrite, same inode
+            fh.write("3.0,0.5,0.6\n")
+        b = next(gen)                      # truncation detected -> reopen
+        assert b is not None
+        np.testing.assert_array_equal(b[1], [3.0])
+        # same inode, same offset 0 — the signature must re-key the id
+        assert b[3] not in first
+    finally:
+        gen.close()
 
 
 def test_producer_restart_dedups_through_wal(tmp_path):
