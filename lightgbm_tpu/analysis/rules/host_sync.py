@@ -77,6 +77,10 @@ SCHED_LOOPS: Set[Tuple[str, str]] = {
     # both the next probe round and shutdown by a full probe interval;
     # all waiting belongs on the stop event
     ("lightgbm_tpu/fleet/replica.py", "_probe_loop"),
+    # the trainer group's join sweeper expires orphaned pending-label
+    # captures across every model: a bare sleep there (instead of waiting
+    # on the stop event) delays shutdown by a full sweep interval
+    ("lightgbm_tpu/online.py", "_sweep_loop"),
 }
 
 

@@ -41,7 +41,10 @@ _SCOPE_FILES = ("lightgbm_tpu/serving.py", "lightgbm_tpu/server.py",
                 "lightgbm_tpu/ingest.py", "lightgbm_tpu/online.py",
                 # the write-ahead feed log is appended by serve-handler
                 # threads and scanned/committed by the refit worker
-                "lightgbm_tpu/wal.py")
+                "lightgbm_tpu/wal.py",
+                # the delayed-label join buffer is mutated by serve-ingress
+                # capture, label-arrival handlers, and the sweep thread
+                "lightgbm_tpu/join.py")
 _SCOPE_DIRS = ("lightgbm_tpu/obs/", "lightgbm_tpu/fleet/")
 _MUTATING_METHODS = {"append", "extend", "add", "update", "setdefault",
                      "pop", "popitem", "clear", "remove", "insert",

@@ -323,6 +323,45 @@ def online_feed(trainer, data_addr: int, nrow: int, ncol: int,
     return int(version or 0)
 
 
+def online_capture(trainer, rid: str, data_addr: int, nrow: int,
+                   ncol: int) -> int:
+    """Capture served features under request id ``rid`` for a delayed-label
+    join (online.feed_features): the rows are WAL-logged immediately and
+    enter training only when ``online_label`` later supplies the outcome.
+    Returns the pending-join count (a duplicate rid is counted and ignored
+    — first capture wins), -1 on malformed input."""
+    src = (ctypes.c_double * (nrow * ncol)).from_address(data_addr)
+    x = np.frombuffer(src, dtype=np.float64).reshape(nrow, ncol).copy()
+    try:
+        return int(trainer.feed_features(rid, x))
+    except ValueError:
+        return -1
+
+
+def online_label(trainer, rid: str, label: float, weight: float) -> int:
+    """Join a late-arriving label against the features captured under
+    ``rid`` and feed the joined rows (online.feed_label). Returns the newly
+    published version when the join triggered a synchronous refit, 0 when
+    it merely buffered, -1 when ``rid`` matched nothing (expired or never
+    captured — counted, never silent)."""
+    w = weight if weight > 0 else None
+    joined_before = trainer.join_stats()["joined"]
+    version = trainer.feed_label(rid, float(label), weight=w)
+    if version is not None:
+        return int(version)
+    # feed_label returns None both for a buffered join and an unmatched
+    # label; the joined counter moving is what distinguishes them
+    return 0 if trainer.join_stats()["joined"] > joined_before else -1
+
+
+def online_join_stats_json(trainer) -> str:
+    """One-line JSON of the delayed-label join plane: pending/joined/
+    expired/unmatched counters plus oldest-pending age (online.join_stats).
+    For an OnlineTrainerGroup handle this reports the default model."""
+    import json
+    return json.dumps(trainer.join_stats(), sort_keys=True)
+
+
 def online_flush(trainer) -> int:
     """Drain pending rows through refit cycles now (synchronous even under
     ``online_async_refit=1``); returns the published version, or 0 when

@@ -309,6 +309,28 @@ _PARAMS: Dict[str, Tuple[Any, Tuple[str, ...]]] = {
     # buffered row -> publish) is tracked through obs/slo.py with refit_lag
     # gauges and freshness_breach events (0 = freshness tracking off)
     "online_freshness_slo_s": (0.0, ("online_freshness_slo",)),
+    # delayed-label join (join.py): seconds a captured feature row-set
+    # waits for its label before expiring as a counted, event-emitting
+    # orphan (join_expired); 0 = pending entries never time out
+    "online_label_timeout_s": (300.0, ("label_timeout_s",)),
+    # resident-payload cap for the join buffer: past this many pending
+    # entries the oldest payloads spill FIFO to their WAL feature records
+    # (dropped outright, counted, when there is no durable copy);
+    # 0 = unbounded resident memory
+    "online_join_max_pending": (100000, ("join_max_pending",)),
+    # unlabeled drift detection: PSI of the served prediction distribution
+    # vs the at-last-fit baseline at/above which the trainer reacts without
+    # waiting for labels (0 = off; <0.1 stable, 0.1-0.25 drifting)
+    "online_drift_psi_max": (0.0, ()),
+    # what an unlabeled drift fire does: "refit" dispatches a refit cycle
+    # on the buffered pending rows (falls back to alarm when none),
+    # "alarm" only emits the drift_unlabeled trip and keeps serving
+    "online_drift_mode": ("refit", ()),
+    # feed WAL behavior when an append fails with a full disk (ENOSPC):
+    # "degrade" continues buffered-only with a wal_degraded trip and
+    # re-arms automatically when space returns; "fatal" propagates the
+    # OSError to the feeder (pre-degrade behavior)
+    "online_wal_full": (("degrade"), ()),
     # ---- observability (new in this framework; see lightgbm_tpu/obs/) ----
     # structured telemetry: schema'd events + metrics around the hot paths;
     # LGBMTPU_TELEMETRY=0/1 env overrides the param in either direction
@@ -530,6 +552,21 @@ class Config:
         if self.online_freshness_slo_s < 0:
             log.fatal("online_freshness_slo_s must be >= 0 (0 = freshness "
                       "tracking off)")
+        if self.online_label_timeout_s < 0:
+            log.fatal("online_label_timeout_s must be >= 0 (0 = pending "
+                      "joins never time out)")
+        if self.online_join_max_pending < 0:
+            log.fatal("online_join_max_pending must be >= 0 (0 = unbounded "
+                      "resident join memory)")
+        if self.online_drift_psi_max < 0:
+            log.fatal("online_drift_psi_max must be >= 0 (0 = unlabeled "
+                      "drift detection off)")
+        if self.online_drift_mode not in ("refit", "alarm"):
+            log.fatal(f"online_drift_mode must be 'refit' or 'alarm', "
+                      f"got '{self.online_drift_mode}'")
+        if self.online_wal_full not in ("degrade", "fatal"):
+            log.fatal(f"online_wal_full must be 'degrade' or 'fatal', "
+                      f"got '{self.online_wal_full}'")
         if not 0 <= self.obs_port <= 65535:
             log.fatal(f"obs_port must be in [0, 65535], got {self.obs_port}")
         if self.serve_slo_ms < 0:

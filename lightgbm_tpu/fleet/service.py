@@ -166,6 +166,16 @@ class FleetServer:
         out = req.result(timeout)
         return out, req.version
 
+    # ---- continuous training (protocol parity with PredictServer) ----
+
+    def attach_online(self, trainer) -> None:
+        """Attach an OnlineTrainer/OnlineTrainerGroup so the !learn and
+        !label protocol commands feed it through this facade; its refit
+        publishes go through :meth:`publish` (fanning to every replica)."""
+        self.online = trainer
+        if hasattr(trainer, "statusz"):
+            obs_http.add_status_section("online", trainer.statusz)
+
     # ---- rollout ----
 
     def ensure_rollout(self, name: Optional[str] = None):
@@ -193,6 +203,8 @@ class FleetServer:
             out["admission"] = self.admission.snapshot()
         if self.rollout is not None:
             out["rollout"] = self.rollout.snapshot()
+        if self.online is not None and hasattr(self.online, "statusz"):
+            out["online"] = self.online.statusz()
         return out
 
     def fleet_stats(self) -> Dict:
@@ -209,6 +221,8 @@ class FleetServer:
     def close(self) -> None:
         self.rollout = None
         self.pool.close()
+        if self.online is not None:
+            obs_http.remove_status_section("online")
         obs_http.remove_status_section("fleet")
         obs_http.stop(self._obs_http)
         self._obs_http = None

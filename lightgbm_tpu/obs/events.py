@@ -174,6 +174,26 @@ EVENT_SCHEMAS: Dict[str, Tuple[Dict[str, Any], Dict[str, Any]]] = {
     # tombstone record), bounding disk + recovery time for bounded-window
     # trainers
     "wal_rotate": ({"batches": int, "rows": int}, {"bytes": int}),
+    # a WAL append failed (disk full) and the log degraded to buffered-only
+    # mode, or space returned and it re-armed (recovered=True); skipped is
+    # the running count of appends refused while degraded — flight-recorder
+    # trip on both transitions
+    "wal_degraded": ({"path": str},
+                     {"recovered": bool, "error": str, "skipped": int}),
+    # delayed-label join (join.py): pending features whose label never
+    # arrived expired into counted drops — reason is "timeout", "overflow"
+    # (resident cap with no durable copy to spill to), or "missing"
+    # (spilled payload unreadable at join time); never silent
+    "join_expired": ({"expired": int, "pending": int},
+                     {"model": str, "oldest_age_s": _NUM, "reason": str}),
+    # the unlabeled drift detector fired: the served prediction
+    # distribution drifted past online_drift_psi_max from the at-last-fit
+    # baseline — no labels involved; action is "refit" (a cycle was
+    # dispatched) or "alarm" (alarm-only mode, or no pending rows to train
+    # on: keep serving last-good) — flight-recorder trip
+    "drift_unlabeled": ({"model": str, "psi": _NUM},
+                        {"ks": _NUM, "samples": int, "action": str,
+                         "threshold": _NUM, "pending_rows": int}),
     # feed->publish freshness crossed online_freshness_slo_s (obs/slo.py
     # FreshnessTracker); emitted on both transitions like slo_breach
     "freshness_breach": ({"model": str, "lag_s": _NUM, "slo_s": _NUM},

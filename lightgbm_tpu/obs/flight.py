@@ -28,9 +28,11 @@ from ..utils import atomic_io
 from .events import _json_default
 
 # event types whose mere occurrence dumps the ring: device faults, the
-# nonfinite guard, and a failed continuous-training refit cycle (the
+# nonfinite guard, a failed continuous-training refit cycle, a feed WAL
+# degraded by a full disk, and the unlabeled drift detector firing (the
 # trainer keeps serving last-good — the dump is the postmortem trail)
-TRIP_EVENTS = ("device_fault", "nonfinite_guard", "online_cycle_failed")
+TRIP_EVENTS = ("device_fault", "nonfinite_guard", "online_cycle_failed",
+               "wal_degraded", "drift_unlabeled")
 _DEF_CAPACITY = 512
 _TRIP_DEBOUNCE_S = 1.0
 

@@ -46,6 +46,26 @@ trips the flight recorder), and retries with exponential backoff; the
 feed->publish lag is watched against ``online_freshness_slo_s`` by
 ``obs.slo.FRESHNESS``. The module-level cycle stats mirror
 ``ingest.LAST_INGEST_STATS`` and take their own lock.
+
+Three label-resilience layers ride on the loop:
+
+- **delayed-label joins** (:mod:`.join`): :meth:`OnlineTrainer.feed_features`
+  captures served features by request id (WAL-durable), a later
+  :meth:`~OnlineTrainer.feed_label` joins the label against them, and only
+  the *joined* rows enter the training buffer via the normal ``feed()``
+  path — orphans expire into counted ``join_expired`` events, never
+  silently;
+- **unlabeled drift detection**: :meth:`~OnlineTrainer.observe_served`
+  streams served prediction distributions through the fleet PSI/KS
+  comparator against an at-last-fit baseline; past
+  ``online_drift_psi_max`` a refit cycle is dispatched (or, in
+  ``online_drift_mode=alarm`` — and always when no labeled rows pend — a
+  ``drift_unlabeled`` trip fires and the last-good model keeps serving);
+- **per-model trainers**: :class:`OnlineTrainerGroup` runs N independent
+  feed->refit->publish loops against one server (per-model WAL dirs,
+  per-model freshness gauges, one shared join-expiry sweep thread) with
+  failure isolation — one model's cycle failure or WAL corruption never
+  blocks or corrupts another's.
 """
 from __future__ import annotations
 
@@ -61,10 +81,12 @@ import numpy as np
 from . import obs
 from .basic import Booster, Dataset
 from .config import canonical_name, params_to_config
+from .fleet.drift import CANDIDATE, INCUMBENT, StreamingComparator
+from .join import JoinBuffer
 from .metrics import create_metrics, default_metric_for_objective
 from .utils import faults, log
 from .utils.log import LightGBMError
-from .wal import FeedLog
+from .wal import FeedLog, WalUnavailable
 
 # last completed refit cycle (bench + test introspection); written under
 # _STATS_LOCK only — trainer threads and bench readers race otherwise
@@ -312,6 +334,19 @@ class OnlineTrainer:
         self.coalesced = 0
         self.last_error = ""
         self.recovery: Dict[str, Any] = {}
+        # ids fed while the WAL was degraded (disk full): not in the log,
+        # so in-process dedup of producer re-sends falls back to this set
+        self._unlogged_ids: set = set()
+        self.wal_skipped = 0
+        # unlabeled drift detection (online_drift_psi_max > 0): served
+        # prediction distribution vs the at-last-fit baseline snapshot
+        self._drift_cmp: Optional[StreamingComparator] = \
+            StreamingComparator(window=self.conf.canary_cmp_window) \
+            if self.conf.online_drift_psi_max > 0 else None
+        self._drift_fired = False
+        self._drift_baseline_ts: Optional[float] = None
+        self._drift_since_eval = 0
+        self.drift_trips = 0
         mnames = self.conf.metric or \
             [default_metric_for_objective(self.conf.objective)]
         ms = create_metrics(mnames[:1], self.conf, self.conf.objective)
@@ -329,7 +364,8 @@ class OnlineTrainer:
             # log rotates committed records the rebuilt dataset can never
             # contain, bounding disk and recovery time
             self.wal = FeedLog(wal_dir,
-                               keep_rows=self.conf.online_max_rows or 0)
+                               keep_rows=self.conf.online_max_rows or 0,
+                               full_mode=self.conf.online_wal_full)
             lc = self.wal.last_commit
             if lc and lc.get("model"):
                 mpath = os.path.join(self.wal.dir, str(lc["model"]))
@@ -377,6 +413,14 @@ class OnlineTrainer:
             self._worker.start()
         if self.wal is not None:
             self._recover(had_commit=recovered is not None)
+        # delayed-label join buffer: built after WAL recovery so rebuild()
+        # resurrects the pending features a crash left behind
+        self._join = JoinBuffer(self._feed_joined, wal=self.wal,
+                                timeout_s=self.conf.online_label_timeout_s,
+                                max_pending=self.conf.online_join_max_pending,
+                                name=self.name)
+        if self.wal is not None:
+            self._join.rebuild()
 
     # ---- internals ----
     def _train_params(self) -> Dict:
@@ -498,7 +542,8 @@ class OnlineTrainer:
 
     # ---- the public loop surface ----
     def feed(self, data, label, weight=None,
-             batch_id: Optional[str] = None) -> Optional[int]:
+             batch_id: Optional[str] = None,
+             join_rid: Optional[str] = None) -> Optional[int]:
         """Buffer one batch; returns the new published version when this
         batch triggered a synchronous refit cycle, else None (always None
         with ``online_async_refit=1`` — the cycle runs on the worker).
@@ -506,7 +551,15 @@ class OnlineTrainer:
         With ``online_wal=1`` the batch is appended to the write-ahead log
         (fsync'd) BEFORE buffering: once feed returns, the batch survives a
         crash. A ``batch_id`` already in the log (a producer re-send after
-        its own restart) is dropped — exactly-once is decided by the id."""
+        its own restart) is dropped — exactly-once is decided by the id.
+        ``join_rid`` (set by the join buffer) rides in the WAL record
+        header, sealing that pending feature atomically with the append.
+
+        A full disk cannot take the feed thread down when
+        ``online_wal_full=degrade``: the failed append degrades the log to
+        buffered-only (``wal_degraded`` trip), this batch trains from
+        memory without durability, and the next append re-arms the log
+        automatically once space returns."""
         X = np.asarray(data, dtype=np.float64)
         if X.ndim == 1:
             X = X[None, :]
@@ -523,12 +576,23 @@ class OnlineTrainer:
         # N+1 with N's rows still unbuffered, and recovery after a crash
         # would classify batch N as already trained — silently losing it
         with self._feed_lock:
-            if batch_id is not None and self.wal.seen(batch_id):
+            if batch_id is not None and (
+                    self.wal.seen(batch_id) or
+                    str(batch_id) in self._unlogged_ids):
                 return None
             try:
-                seq = self.wal.append_batch(X, y, w, batch_id=batch_id)
+                seq = self.wal.append_batch(X, y, w, batch_id=batch_id,
+                                            join_rid=join_rid)
             except ValueError:
                 return None  # duplicate id raced in from another thread
+            except WalUnavailable:
+                # degraded log (disk full): train the batch from memory —
+                # it is NOT durable, so dedup its id in-process only
+                seq = 0
+                if batch_id is not None:
+                    self._unlogged_ids.add(str(batch_id))
+                with self._lock:
+                    self.wal_skipped += 1
             trigger = self._buffer_rows(X, y, w, seq)
         return self._dispatch(trigger, X, y, w)
 
@@ -566,6 +630,121 @@ class OnlineTrainer:
                 return None
             return self.refit_now(trigger=trigger)
         return None
+
+    # ---- delayed-label join surface (join.py) ----
+    def _feed_joined(self, rid: str, X, y, w) -> Optional[int]:
+        """JoinBuffer's feed hook: a joined row trains through the normal
+        feed() path under its derived batch id (idempotent re-sends), with
+        the rid sealing the pending feature in the same WAL record."""
+        return self.feed(X, y, weight=w,
+                         batch_id=JoinBuffer.batch_id_for(rid),
+                         join_rid=rid)
+
+    def feed_features(self, rid: str, data) -> int:
+        """Capture served features under request id ``rid`` (serve-time
+        ingress half of the delayed-label join); returns the pending
+        count. Durable before return when the WAL is on."""
+        return self._join.capture(rid, data)
+
+    def feed_label(self, rid: str, label, weight=None) -> Optional[int]:
+        """Join an arriving label against the features captured under
+        ``rid``; the completed rows enter the training buffer. Returns
+        what feed() returned (a version for a sync-triggered cycle), or
+        None for unmatched/duplicate/expired labels — counted in
+        :meth:`join_stats`, never silent."""
+        return self._join.label(rid, label, weight=weight)
+
+    def sweep_joins(self) -> int:
+        """Expire pending joins older than ``online_label_timeout_s`` (the
+        trainer group's sweep loop calls this; single trainers sweep
+        opportunistically on capture/label)."""
+        return self._join.sweep()
+
+    def join_stats(self) -> Dict[str, Any]:
+        return self._join.stats()
+
+    # ---- unlabeled drift detection ----
+    # evaluate PSI once per this many fresh served scores (the comparator
+    # itself is O(window) per evaluation — keep it off the per-request
+    # path), and not before either side holds a meaningful sample
+    DRIFT_EVAL_EVERY = 64
+    DRIFT_MIN_SCORES = 64
+
+    def observe_served(self, scores) -> None:
+        """Stream served prediction values into the drift comparator
+        (no-op unless ``online_drift_psi_max > 0``). Until the first
+        baseline exists the scores seed the incumbent side — the serving
+        model IS the last-fit model, so its early distribution is the
+        at-last-fit snapshot; each refit re-baselines from the new model
+        (:meth:`_rebaseline_drift`)."""
+        cmp_ = self._drift_cmp
+        if cmp_ is None:
+            return
+        vals = np.asarray(scores, dtype=np.float64).reshape(-1)
+        if vals.size == 0:
+            return
+        with self._lock:
+            seeded = self._drift_baseline_ts is not None
+        if not seeded:
+            cmp_.observe(INCUMBENT, vals)
+            n_ref, _ = cmp_.counts()
+            if n_ref >= self.DRIFT_MIN_SCORES:
+                with self._lock:
+                    self._drift_baseline_ts = time.time()
+            return
+        cmp_.observe(CANDIDATE, vals)
+        with self._lock:
+            if self._drift_fired:
+                return
+            self._drift_since_eval += int(vals.size)
+            if self._drift_since_eval < self.DRIFT_EVAL_EVERY:
+                return
+            self._drift_since_eval = 0
+        n_ref, n_cand = cmp_.counts()
+        if min(n_ref, n_cand) < self.DRIFT_MIN_SCORES:
+            return
+        psi = cmp_.psi()
+        if psi <= self.conf.online_drift_psi_max:
+            return
+        with self._lock:
+            if self._drift_fired:
+                return
+            self._drift_fired = True
+            self.drift_trips += 1
+            pend = int(self.pending_rows)
+        # graceful degradation: refit only when there are labeled rows to
+        # train on — scarce labels mean alarm + keep serving last-good
+        action = "refit" if (self.conf.online_drift_mode == "refit"
+                             and pend > 0) else "alarm"
+        obs.emit("drift_unlabeled", model=self.name, psi=float(psi),
+                 ks=float(cmp_.ks()), samples=int(n_cand), action=action,
+                 threshold=float(self.conf.online_drift_psi_max),
+                 pending_rows=pend)
+        if action == "refit":
+            if self._async:
+                self._submit("drift_unlabeled")
+            else:
+                try:
+                    self.refit_now(trigger="drift_unlabeled")
+                except Exception as e:
+                    # recorded + flight-dumped by refit_now already; the
+                    # serve request that happened to trip the detector
+                    # must not fail because training did
+                    log.warning(f"drift-triggered refit failed: {e}")
+
+    def _rebaseline_drift(self, booster: Booster, X) -> None:
+        """At-last-fit snapshot: a fresh comparator whose incumbent side is
+        the refit model's own score distribution over the rows that closed
+        the cycle. Swapping the comparator atomically re-arms the trigger."""
+        old = self._drift_cmp
+        cmp_ = StreamingComparator(window=old.window, bins=old.bins)
+        take = min(int(X.shape[0]), int(old.window))
+        cmp_.observe(INCUMBENT, booster.predict(X[-take:]))
+        with self._lock:
+            self._drift_cmp = cmp_
+            self._drift_fired = False
+            self._drift_baseline_ts = time.time()
+            self._drift_since_eval = 0
 
     def flush(self) -> Optional[int]:
         """Drain pending rows through refit cycles now (end-of-stream).
@@ -670,6 +849,8 @@ class OnlineTrainer:
         if self.wal is not None:
             self.wal.commit(int(cyc["seq"]), int(version), model=model_name,
                             baseline=baseline, cycle=cycles)
+        if self._drift_cmp is not None:
+            self._rebaseline_drift(new_bst, X)
         lag_s = (time.time() - cyc["oldest"]) if cyc["oldest"] else 0.0
         obs.slo.FRESHNESS.observe_cycle(self.name, lag_s, rows=int(n))
         duration_s = time.time() - t0
@@ -762,6 +943,21 @@ class OnlineTrainer:
                 out["last_error"] = self.last_error
             oldest = self._pend_oldest_ts
         out["pending_lag_s"] = (time.time() - oldest) if oldest else 0.0
+        out["join"] = self._join.stats()
+        if self._drift_cmp is not None:
+            with self._lock:
+                bts = self._drift_baseline_ts
+                fired = self._drift_fired
+                trips = self.drift_trips
+            snap = self._drift_cmp.snapshot()
+            out["drift"] = {
+                "psi_max": float(self.conf.online_drift_psi_max),
+                "mode": self.conf.online_drift_mode,
+                "baseline_age_s":
+                    None if bts is None else round(time.time() - bts, 3),
+                "fired": bool(fired), "trips": int(trips), **snap}
+        if self.wal_skipped:
+            out["wal_skipped"] = int(self.wal_skipped)
         if self._queue is not None:
             out["queued"] = int(self._queue.qsize())
         if self.wal is not None:
@@ -810,3 +1006,179 @@ class OnlineTrainer:
         if flush_at_end and self.pending_rows:
             self.flush()
         return fed
+
+
+class OnlineTrainerGroup:
+    """N independent continuous-training loops keyed by model name, behind
+    one server.
+
+    >>> group = OnlineTrainerGroup(params, server=srv)
+    >>> group.add("clicks", ds_a, booster=bst_a)
+    >>> group.add("installs", ds_b, booster=bst_b)
+    >>> group.feed(X, y, model="clicks")
+    >>> group.feed_label(rid, y, model="installs")
+
+    Isolation is the contract: each trainer owns its Dataset, booster,
+    locks, async worker, join buffer, and — per-model subdirectory under
+    ``online_wal_dir`` — its WAL, so one model's cycle failure or WAL
+    corruption cannot block, corrupt, or delay another's feed/refit/publish
+    path. Shared pieces are append-only or already keyed per model: the
+    registry publishes under each trainer's name and the freshness tracker
+    gauges per model. One daemon thread (``_sweep_loop``) sweeps every
+    trainer's join expiry on a fixed cadence with per-trainer exception
+    containment.
+
+    The group quacks enough like a single trainer for the serve plumbing —
+    ``feed``/``feed_label``/``feed_features``/``observe_served`` take an
+    optional ``model=`` and default to the first trainer added, and
+    ``statusz``/``pending_rows``/``flush``/``close`` span all models — so
+    ``PredictServer.attach_online`` and the ``!learn``/``!label`` line
+    protocol work unchanged.
+    """
+
+    SWEEP_INTERVAL_S = 0.5
+
+    def __init__(self, params: Optional[Dict] = None, server=None,
+                 registry=None):
+        self.params = dict(params or {})
+        self.conf = params_to_config(self.params)
+        self.server = server
+        self.registry = registry
+        self._lock = threading.Lock()
+        self._trainers: Dict[str, OnlineTrainer] = {}
+        self._default: Optional[str] = None
+        self._stop = threading.Event()
+        self._sweeper: Optional[threading.Thread] = None
+
+    # ---- membership ----
+    def add(self, name: str, dataset: Dataset,
+            booster: Optional[Booster] = None,
+            params: Optional[Dict] = None) -> OnlineTrainer:
+        """Create and register the trainer for ``name``. Per-model params
+        overlay the group's; with the WAL on, each model logs under its own
+        ``<online_wal_dir>/<name>`` subdirectory (corruption of one model's
+        log is invisible to every other)."""
+        name = str(name)
+        with self._lock:
+            if name in self._trainers:
+                raise ValueError(f"online trainer {name!r} already exists")
+        p = dict(self.params)
+        p.update(params or {})
+        conf = params_to_config(p)
+        if conf.online_wal:
+            base = conf.online_wal_dir or os.path.join(
+                os.path.dirname(conf.output_model) or ".", "online_wal")
+            p["online_wal_dir"] = os.path.join(base, name)
+        tr = OnlineTrainer(p, dataset, booster=booster, server=self.server,
+                           registry=self.registry, name=name)
+        start_sweeper = False
+        with self._lock:
+            lost_race = name in self._trainers
+            if not lost_race:
+                self._trainers[name] = tr
+            if not lost_race:
+                if self._default is None:
+                    self._default = name
+                if self._sweeper is None and \
+                        tr.conf.online_label_timeout_s > 0:
+                    self._sweeper = threading.Thread(
+                        target=self._sweep_loop,
+                        name="lgbm-online-join-sweep", daemon=True)
+                    start_sweeper = True
+        if lost_race:   # a concurrent add won the name while we trained
+            tr.close()
+            raise ValueError(f"online trainer {name!r} already exists")
+        if start_sweeper:
+            self._sweeper.start()
+        return tr
+
+    def get(self, model: Optional[str] = None) -> OnlineTrainer:
+        with self._lock:
+            name = str(model) if model is not None else self._default
+            if name is None or name not in self._trainers:
+                raise KeyError(f"no online trainer named {name!r}; have "
+                               f"{sorted(self._trainers)}")
+            return self._trainers[name]
+
+    def names(self) -> List[str]:
+        with self._lock:
+            return sorted(self._trainers)
+
+    def trainers(self) -> List[OnlineTrainer]:
+        with self._lock:
+            return list(self._trainers.values())
+
+    # ---- single-trainer protocol parity (model= routes; default = first
+    # added, so one-model groups behave exactly like a bare trainer) ----
+    def feed(self, data, label, weight=None, batch_id: Optional[str] = None,
+             model: Optional[str] = None) -> Optional[int]:
+        return self.get(model).feed(data, label, weight=weight,
+                                    batch_id=batch_id)
+
+    def feed_features(self, rid: str, data,
+                      model: Optional[str] = None) -> int:
+        return self.get(model).feed_features(rid, data)
+
+    def feed_label(self, rid: str, label, weight=None,
+                   model: Optional[str] = None) -> Optional[int]:
+        return self.get(model).feed_label(rid, label, weight=weight)
+
+    def observe_served(self, scores, model: Optional[str] = None) -> None:
+        self.get(model).observe_served(scores)
+
+    def join_stats(self, model: Optional[str] = None) -> Dict[str, Any]:
+        return self.get(model).join_stats()
+
+    @property
+    def pending_rows(self) -> int:
+        return sum(tr.pending_rows for tr in self.trainers())
+
+    @property
+    def version(self) -> int:
+        try:
+            return self.get().version
+        except KeyError:
+            return 0
+
+    def flush(self, model: Optional[str] = None) -> Optional[int]:
+        if model is not None:
+            return self.get(model).flush()
+        out = None
+        for tr in self.trainers():
+            v = tr.flush()
+            out = v if v is not None else out
+        return out
+
+    def sweep_joins(self) -> int:
+        return sum(tr.sweep_joins() for tr in self.trainers())
+
+    def statusz(self) -> Dict[str, Any]:
+        return {"models": {tr.name: tr.statusz()
+                           for tr in self.trainers()}}
+
+    # ---- join-expiry sweep loop ----
+    def _sweep_loop(self) -> None:
+        """Walk every trainer's join buffer on a fixed cadence so orphaned
+        pending features expire even when no captures/labels arrive. Waits
+        on the stop event (never a bare sleep: tpu-lint scheduler-loop
+        scope) and contains per-trainer failures — one model's broken sweep
+        must not stall the others'."""
+        while not self._stop.is_set():
+            if self._stop.wait(self.SWEEP_INTERVAL_S):
+                return
+            for tr in self.trainers():
+                try:
+                    tr.sweep_joins()
+                except Exception as e:
+                    log.warning(
+                        f"join sweep for model {tr.name!r} failed: {e}")
+
+    def close(self) -> None:
+        """Stop the sweep loop, then close every trainer. Idempotent."""
+        self._stop.set()
+        with self._lock:
+            sweeper, self._sweeper = self._sweeper, None
+        if sweeper is not None:
+            sweeper.join()
+        for tr in self.trainers():
+            tr.close()

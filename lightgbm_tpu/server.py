@@ -728,12 +728,45 @@ class PredictServer:
             self.publish(model, name=name)
 
     def attach_online(self, trainer) -> None:
-        """Attach an :class:`~.online.OnlineTrainer` so the ``!learn``
-        protocol command feeds it labeled rows; each refit cycle it triggers
-        publishes back into this server's registry (zero-downtime swap)."""
+        """Attach an :class:`~.online.OnlineTrainer` (or a keyed
+        :class:`~.online.OnlineTrainerGroup`) so the ``!learn``/``!label``
+        protocol commands feed it and served predictions stream into its
+        unlabeled drift comparator; each refit cycle it triggers publishes
+        back into this server's registry (zero-downtime swap)."""
         self.online = trainer
         if hasattr(trainer, "statusz"):
             obs_http.add_status_section("online", trainer.statusz)
+
+    def _online_capture(self, rid: str, x, model: str) -> None:
+        """Serve-time ingress half of the delayed-label join: file the
+        request's features with the online trainer BEFORE predicting, so a
+        label arriving after a crash still joins (the capture is
+        WAL-durable when the trainer logs)."""
+        tr = self.online
+        if tr is None or not hasattr(tr, "feed_features"):
+            raise LightGBMError(
+                "capture_id needs an attached online trainer")
+        from .online import OnlineTrainerGroup
+        if isinstance(tr, OnlineTrainerGroup):
+            tr.feed_features(rid, x, model=model)
+        else:
+            tr.feed_features(rid, x)
+
+    def _online_observe(self, out, model: str) -> None:
+        """Drift tap: stream served scores into the trainer's unlabeled
+        drift comparator (no-op unless online_drift_psi_max is set)."""
+        tr = self.online
+        fn = None if tr is None else getattr(tr, "observe_served", None)
+        if fn is None:
+            return
+        try:
+            from .online import OnlineTrainerGroup
+            if isinstance(tr, OnlineTrainerGroup):
+                fn(out, model=model)
+            else:
+                fn(out)
+        except KeyError:
+            pass   # no trainer under this serve-model name: nothing to watch
 
     def _warmup_sizes(self) -> Tuple[int, ...]:
         """1 + every power-of-two bucket up to serve_max_batch_rows, so the
@@ -768,18 +801,32 @@ class PredictServer:
 
     def predict(self, x, model: str = "default", raw_score: bool = False,
                 pred_leaf: bool = False,
-                timeout: Optional[float] = None) -> np.ndarray:
-        return self.submit(x, model=model, raw_score=raw_score,
-                           pred_leaf=pred_leaf).result(timeout)
+                timeout: Optional[float] = None,
+                capture_id: Optional[str] = None) -> np.ndarray:
+        """Predict; with ``capture_id`` the features are first filed with
+        the attached online trainer for a delayed-label join (the label
+        arrives later via ``feed_label``/``!label``)."""
+        if capture_id is not None:
+            self._online_capture(capture_id, x, model)
+        out = self.submit(x, model=model, raw_score=raw_score,
+                          pred_leaf=pred_leaf).result(timeout)
+        if self.online is not None and not raw_score and not pred_leaf:
+            self._online_observe(out, model)
+        return out
 
     def predict_versioned(self, x, model: str = "default",
-                          timeout: Optional[float] = None
+                          timeout: Optional[float] = None,
+                          capture_id: Optional[str] = None
                           ) -> Tuple[np.ndarray, int]:
         """Predict + the version that actually served it — read off the
         request itself, so the answer is race-free across concurrent
         hot-swaps (and reflects canary routing when a rollout is live)."""
+        if capture_id is not None:
+            self._online_capture(capture_id, x, model)
         req = self.submit(x, model=model)
         out = req.result(timeout)
+        if self.online is not None:
+            self._online_observe(out, model)
         return out, req.version
 
     def submit(self, x, **kw) -> _Request:
@@ -842,6 +889,10 @@ class PredictServer:
             out["admission"] = self.admission.snapshot()
         if self.rollout is not None:
             out["rollout"] = self.rollout.snapshot()
+        if self.online is not None and hasattr(self.online, "statusz"):
+            # per-model join/drift/WAL state rides along, so !stats and
+            # server_stats_json mirror the /statusz online section
+            out["online"] = self.online.statusz()
         return out
 
     def fleet_stats(self) -> Dict:
@@ -869,12 +920,21 @@ class PredictServer:
 # ---- transports (task=serve): newline-delimited request protocol ----
 #
 #   <v1>,<v2>,...      feature row  ->  "<version>\t<val>[,<val>...]"
+#   <rid>|<v1>,<v2>,.. feature row + delayed-label capture: the features
+#                      are filed with the online trainer under request id
+#                      <rid> (WAL-durable) BEFORE predicting, so a later
+#                      "!label <rid> ..." joins them
+#                                   ->  "<version>\t<val>[,<val>...]"
 #   !publish <path>    hot-swap     ->  "ok version=<n>"
 #   !learn <y>,<v1>,.. labeled row into the attached OnlineTrainer
 #                                   ->  "ok pending=<n>[ version=<v>]"
 #                      (version only when the row triggered a synchronous
 #                      refit; under online_async_refit the cycle runs on
 #                      the trainer's worker and the reply never waits)
+#   !label <rid> <y>   late-arriving label joins the features captured
+#                      under <rid>; unmatched/duplicate labels are counted,
+#                      never trained
+#                                   ->  "ok pending=<n> joined=<n>[ version=<v>]"
 #   !canary <path> [fraction] [shadow|canary]
 #                      start a rollout -> "ok version=<n> mode=<m>"
 #   !promote           promote the canary now -> "ok version=<n>"
@@ -926,6 +986,25 @@ def handle_line(server, line: str, model: str = "default") -> Optional[str]:
                 return f"error: learn failed: {e}"
             tail = f" version={ver}" if ver else ""
             return f"ok pending={server.online.pending_rows}{tail}"
+        if cmd[0] == "!label":
+            # delayed-label join: "!label <request-id> <label> [weight]"
+            # joins a late label against the features a "<rid>|<v1>,..."
+            # predict line captured earlier
+            if server.online is None:
+                return "error: no online trainer attached"
+            args = cmd[1].split() if len(cmd) > 1 else []
+            if len(args) < 2:
+                return "error: !label needs <request-id> <label>"
+            try:
+                w = float(args[2]) if len(args) > 2 else None
+                ver = server.online.feed_label(args[0], float(args[1]),
+                                               weight=w)
+                js = server.online.join_stats()
+            except Exception as e:
+                return f"error: label failed: {e}"
+            tail = f" version={ver}" if ver else ""
+            return (f"ok pending={js.get('pending', 0)} "
+                    f"joined={js.get('joined', 0)}{tail}")
         if cmd[0] == "!canary":
             # "!canary <path> [fraction] [shadow|canary]" — start a rollout
             args = cmd[1].split() if len(cmd) > 1 else []
@@ -963,10 +1042,22 @@ def handle_line(server, line: str, model: str = "default") -> Optional[str]:
             return json.dumps(server.fleet_stats(), sort_keys=True)
         return f"error: unknown command {cmd[0]}"
     try:
+        # "<rid>|<features>" asks for delayed-label capture at ingress:
+        # the features are filed under <rid> before the predict, so the
+        # later "!label <rid> <y>" can join them (a crash in between loses
+        # nothing — the capture is WAL-durable)
+        rid = None
+        if "|" in line:
+            rid, _, line = line.partition("|")
+            rid = rid.strip() or None
         parts = line.replace(",", " ").split()
         if not parts:
             raise ValueError("no features parsed")
         x = np.array([float(p) for p in parts], dtype=np.float64)
+        if rid is not None:
+            if server.online is None:
+                return "error: no online trainer attached for capture"
+            server.online.feed_features(rid, x)
         # version comes off the request itself (not a second registry read):
         # race-free under hot-swap, and honest under canary routing
         out, ver = server.predict_versioned(x, model=model)

@@ -60,6 +60,20 @@ point                     fires in
                           built, before artifact save + publish + WAL
                           commit (pre-publish crash: replay retrains the
                           same batches deterministically)
+``join_capture``          wal.py append_feature — right AFTER a served
+                          feature row-set is fsync'd as a pending FEAT
+                          record (crash here: the pending join is durable,
+                          the in-memory entry may not be — recovery
+                          rebuilds it, the label still joins)
+``join_label``            join.py label() — label in hand, pending entry
+                          popped, join NOT yet durable (crash here: the
+                          feature record survives, the producer re-sends
+                          the label)
+``join_commit``           join.py label() — right AFTER the joined batch
+                          was fed (the WAL batch record seals the join)
+                          but before the producer sees the ack (crash
+                          here: the re-sent label must dedup, not
+                          double-train)
 ========================  ===================================================
 
 The last four are the DEVICE-level chaos points (:data:`DEVICE_FAULT_POINTS`)
@@ -88,7 +102,10 @@ KNOWN_POINTS = ("snapshot_write", "mapper_allgather", "dist_init",
                 # tests/test_online_wal.py): feed -> append -> train ->
                 # publish, one point per window
                 "wal_append", "dataset_append", "online_train",
-                "online_publish")
+                "online_publish",
+                # delayed-label join crash windows (tests/test_online_join.py):
+                # feature capture -> label arrival -> join-commit
+                "join_capture", "join_label", "join_commit")
 
 # chaos points that simulate DEVICE failures (OOM, lost chip, dead
 # collective): their injected errors classify as device faults and route

@@ -19,9 +19,13 @@ Measures the three costs the online loop (online.py) exists to bound:
   feed log on vs off (every batch fsync'd before buffering), crash-recovery
   time (log scan + trainer replay + catch-up cycle over the same batches),
   and feed->publish freshness latency in sync and async refit modes.
+- ``join``: what delayed-label joins cost — serve-path p50/p99 delta of a
+  predict with feature capture vs without, capture + label-join throughput
+  against a deep pending set (100k ids full, smaller in --quick), and the
+  restart recovery-scan time over that same deep pending set.
 
 Usage: python scripts/bench_online.py [--quick] [out.json]
-Env: LGBM_TPU_ONLINE_BENCH_ROWS / _ITERS / _SECONDS / _CLIENTS
+Env: LGBM_TPU_ONLINE_BENCH_ROWS / _ITERS / _SECONDS / _CLIENTS / _PENDING
 """
 import json
 import os
@@ -226,6 +230,93 @@ def run(out_path=None, quick=False):
     finally:
         shutil.rmtree(wal_root, ignore_errors=True)
 
+    # ---- delayed-label joins: capture overhead, throughput, recovery ----
+    from lightgbm_tpu.join import JoinBuffer
+
+    n_pend = int(os.environ.get("LGBM_TPU_ONLINE_BENCH_PENDING", 100_000))
+    if quick:
+        n_pend = min(n_pend, 5_000)
+    n_lab = max(n_pend // 10, 1)
+    join_root = tempfile.mkdtemp(prefix="lgbm_join_bench_")
+    join = {}
+    try:
+        jp = dict(params)
+        jp.update({"online_refit_rows": 10 ** 9, "online_boost_rounds": 0,
+                   "online_wal": True, "online_label_timeout_s": 0,
+                   "online_wal_dir": os.path.join(join_root, "wal")})
+        jds = lgb.Dataset(X[:half], label=y[:half], params=jp)
+        tr = OnlineTrainer(jp, jds, booster=booster)
+
+        # serve-path overhead: predict vs predict-with-capture, p50/p99
+        srv = PredictServer(jp, model=booster)
+        srv.attach_online(tr)
+        q1 = queries[0]
+        for _ in range(20):
+            srv.predict(q1)                       # warm the n=1 bucket
+        n_probe = 100 if quick else 300
+        plain, cap = [], []
+        for i in range(n_probe):
+            t0 = time.perf_counter()
+            srv.predict(q1)
+            plain.append(time.perf_counter() - t0)
+        for i in range(n_probe):
+            t0 = time.perf_counter()
+            srv.predict(q1, capture_id=f"probe-{i:06d}")
+            cap.append(time.perf_counter() - t0)
+        srv.close()
+        pp, pc = _percentiles(plain), _percentiles(cap)
+        join["serve_capture_overhead"] = {
+            "requests": n_probe,
+            "predict_p50_ms": pp["p50_ms"], "predict_p99_ms": pp["p99_ms"],
+            "capture_p50_ms": pc["p50_ms"], "capture_p99_ms": pc["p99_ms"],
+            "p50_delta_ms": round(pc["p50_ms"] - pp["p50_ms"], 4),
+            "p99_delta_ms": round(pc["p99_ms"] - pp["p99_ms"], 4),
+        }
+        print(f"# capture overhead: p50 {pp['p50_ms']:.3f} -> "
+              f"{pc['p50_ms']:.3f} ms, p99 {pp['p99_ms']:.3f} -> "
+              f"{pc['p99_ms']:.3f} ms", file=sys.stderr)
+
+        # capture + join throughput against a deep pending set
+        rows1 = np.ascontiguousarray(X[:1024])
+        t0 = time.perf_counter()
+        for i in range(n_pend):
+            tr.feed_features(f"j{i:07d}", rows1[i % 1024])
+        capture_s = time.perf_counter() - t0
+        t0 = time.perf_counter()
+        for i in range(n_lab):
+            tr.feed_label(f"j{i:07d}", float(y[i % 1024]))
+        label_s = time.perf_counter() - t0
+        js = tr.join_stats()
+        join["deep_pending"] = {
+            "pending_ids": n_pend,
+            "capture_s": round(capture_s, 3),
+            "capture_rows_per_s": round(n_pend / capture_s, 1),
+            "labels_joined": n_lab,
+            "join_s": round(label_s, 3),
+            "join_rows_per_s": round(n_lab / label_s, 1),
+            "pending_after": js["pending"],
+        }
+        print(f"# join: captured {n_pend} ids at "
+              f"{n_pend / capture_s:,.0f}/s, joined {n_lab} labels at "
+              f"{n_lab / label_s:,.0f}/s", file=sys.stderr)
+        tr.close()
+
+        # restart recovery: scan + pending-set rebuild over the deep log
+        t0 = time.perf_counter()
+        fl = FeedLog(jp["online_wal_dir"])
+        jb = JoinBuffer(lambda rid, Xr, yr, w: 0, wal=fl)
+        recovered = jb.rebuild()
+        rescan_s = time.perf_counter() - t0
+        fl.close()
+        join["recovery_scan"] = {
+            "pending_recovered": recovered,
+            "scan_s": round(rescan_s, 3),
+        }
+        print(f"# join recovery: {recovered} pending ids rebuilt in "
+              f"{rescan_s:.3f}s", file=sys.stderr)
+    finally:
+        shutil.rmtree(join_root, ignore_errors=True)
+
     # ---- served-QPS dip across a mid-load refit + hot swap ----
     hp = dict(params)
     hp.update({"online_refit_rows": 10 ** 9, "online_boost_rounds": 0})
@@ -297,6 +388,7 @@ def run(out_path=None, quick=False):
         "append": append,
         "cycles": cycles,
         "wal": wal,
+        "join": join,
         "hot_swap": hot_swap,
     }
     doc = json.dumps(result, indent=2)

@@ -399,8 +399,8 @@ def test_concurrent_feed_cannot_commit_unbuffered_seq(tmp_path, monkeypatch):
     in_wal, release = threading.Event(), threading.Event()
     orig = FeedLog.append_batch
 
-    def parked_append(self, X, y, w=None, batch_id=None):
-        seq = orig(self, X, y, w, batch_id=batch_id)
+    def parked_append(self, X, y, w=None, batch_id=None, **kw):
+        seq = orig(self, X, y, w, batch_id=batch_id, **kw)
         if batch_id == "parked":
             in_wal.set()
             release.wait(10)

@@ -1738,3 +1738,106 @@ def test_wal_append_write_needs_suppression():
     assert "non-atomic-artifact-write" in names(kept)
     assert "non-atomic-artifact-write" not in names(
         analyze_source(WAL_WRITE_CLEAN, relpath=WAL_REL))
+
+# ---- delayed-label join rule scopes (PR: label-resilient training) ----
+# join.py joins the shared-state scope (serve-ingress capture threads,
+# label-arrival handlers, and the group's sweep thread all mutate the
+# pending map), and the trainer group's _sweep_loop joins the scheduler-loop
+# audit — it walks EVERY model's join buffer, so a bare sleep there delays
+# both orphan expiry and shutdown across the whole group.
+
+JOIN_REL = "lightgbm_tpu/join.py"
+
+JOIN_SHARED_BAD = """
+_PENDING_BY_NAME = {}
+
+def register_buffer(name, buf):
+    _PENDING_BY_NAME[name] = buf
+"""
+
+JOIN_SHARED_SUPPRESSED = """
+_PENDING_BY_NAME = {}
+
+def register_buffer(name, buf):
+    # built once at trainer construction, read-only afterwards
+    _PENDING_BY_NAME[name] = buf   # tpu-lint: disable=unlocked-shared-state
+"""
+
+JOIN_SHARED_LOCKED = """
+import threading
+_PENDING_BY_NAME = {}
+_LOCK = threading.Lock()
+
+def register_buffer(name, buf):
+    with _LOCK:
+        _PENDING_BY_NAME[name] = buf
+"""
+
+
+def test_join_module_in_shared_state_scope():
+    assert "unlocked-shared-state" in names(
+        analyze_source(JOIN_SHARED_BAD, relpath=JOIN_REL))
+    assert "unlocked-shared-state" not in names(
+        analyze_source(JOIN_SHARED_SUPPRESSED, relpath=JOIN_REL))
+    kept = analyze_source(JOIN_SHARED_SUPPRESSED, relpath=JOIN_REL,
+                          keep_suppressed=True)
+    assert "unlocked-shared-state" in names(kept)
+    assert "unlocked-shared-state" not in names(
+        analyze_source(JOIN_SHARED_LOCKED, relpath=JOIN_REL))
+    # the same mutation outside the designated scope is the normal idiom
+    assert "unlocked-shared-state" not in names(
+        analyze_source(JOIN_SHARED_BAD, relpath="lightgbm_tpu/basic.py"))
+
+
+SWEEP_LOOP_BAD = """
+import time
+
+def _sweep_loop(self):
+    while True:
+        time.sleep(0.5)
+        self._reaper.join()
+        for tr in self.trainers():
+            tr.sweep_joins()
+"""
+
+SWEEP_LOOP_SUPPRESSED = """
+import time
+
+def _sweep_loop(self):
+    while not self._stop.is_set():
+        # drill harness: the pause paces injected expiry rounds
+        time.sleep(0.5)   # tpu-lint: disable=host-sync-in-jit
+        for tr in self.trainers():
+            tr.sweep_joins()
+"""
+
+SWEEP_LOOP_CLEAN = """
+def _sweep_loop(self):
+    while not self._stop.is_set():
+        if self._stop.wait(0.5):
+            return
+        for tr in self.trainers():
+            tr.sweep_joins()
+"""
+
+
+def test_group_sweep_loop_blocking_calls_fire():
+    found = analyze_source(SWEEP_LOOP_BAD, relpath=ONLINE_REL)
+    assert "host-sync-in-jit" in names(found)
+    msgs = [f.message for f in found if f.rule == "host-sync-in-jit"]
+    assert any("sleep" in m for m in msgs), msgs
+    assert any(".join()" in m for m in msgs), msgs
+    # _sweep_loop elsewhere is not a designated scheduler loop
+    assert "host-sync-in-jit" not in names(
+        analyze_source(SWEEP_LOOP_BAD, relpath="lightgbm_tpu/basic.py"))
+
+
+def test_group_sweep_loop_suppressed_and_clean():
+    assert "host-sync-in-jit" not in names(
+        analyze_source(SWEEP_LOOP_SUPPRESSED, relpath=ONLINE_REL))
+    kept = analyze_source(SWEEP_LOOP_SUPPRESSED, relpath=ONLINE_REL,
+                          keep_suppressed=True)
+    assert "host-sync-in-jit" in names(kept)
+    # the shipped idiom — wait on the stop event, bounded — is clean
+    assert "host-sync-in-jit" not in names(
+        analyze_source(SWEEP_LOOP_CLEAN, relpath=ONLINE_REL))
