@@ -158,6 +158,18 @@ def main():
     import lightgbm_tpu as lgb
     from lightgbm_tpu import obs
 
+    # backend preflight: the emitted metric carries `backend` as a MANDATORY
+    # top-level field, so a CPU-container run (r06's 0.129 iters/s) can never
+    # be mistaken for a TPU regression when BENCH_* files are compared. Warn
+    # loudly up front too — before minutes of data generation.
+    backend = jax.default_backend()
+    if backend != "tpu":
+        print("#" * 72, file=sys.stderr)
+        print(f"# WARNING: bench running on backend={backend!r}, NOT tpu —"
+              " the emitted\n# numbers are not comparable to the BENCH_*"
+              " trajectory.", file=sys.stderr)
+        print("#" * 72, file=sys.stderr)
+
     # the bench always runs with telemetry on: the cold/warm compile split
     # and the prewarm hit/miss accounting below are sourced from the obs
     # compile/aot_prewarm events, not from wall-clock guessing
@@ -217,6 +229,7 @@ def main():
         print(json.dumps({
             "metric": f"boosting_iters_per_sec_{objective}_"
                       f"{n_rows // 1_000_000}m_l{num_leaves}_b{max_bin}",
+            "backend": backend,
             "value": round(iters_per_sec, 4), "unit": "iters/sec",
             "vs_baseline": round(iters_per_sec / baseline_here, 4),
             "bin_s": round(t_bin, 2), "bin_phases": ds.construct_phases,
@@ -265,6 +278,8 @@ def main():
     result = {
         "metric": f"boosting_iters_per_sec_higgs{rows_tag}"
                   f"_l{num_leaves}_b{max_bin}",
+        # mandatory: BENCH_* comparisons must reject cross-backend deltas
+        "backend": backend,
         "value": round(iters_per_sec, 4),
         "unit": "iters/sec",
         "vs_baseline": round(iters_per_sec / baseline_here, 4),

@@ -11,6 +11,18 @@ prewarm off) so the counts are exact, deterministic, and CPU-cheap. The
 ``predict_warm_repeat`` entry re-runs predict on the same shapes and MUST
 measure 0 — it is the per-call-jit canary: any lowering there means a jit
 wrapper is being rebuilt per call instead of reused.
+
+Beyond the plain-gbdt quartet the probe guards the rest of the optimized
+surface:
+
+- ``train_3_iters_lossguide``: the leaf-wise grower's step program (the
+  default quartet trains depthwise);
+- ``train_warm_extra2_{dart,goss,rf}``: two EXTRA iterations on an
+  already-warmed booster of each non-gbdt flavour, budgeted at 0 — DART's
+  drop/normalize reweighting, GOSS's gradient-dependent bagging and RF's
+  averaging custom step must all reuse their warmed wrappers;
+- ``predict_engine_warm``: serving predicts at row counts whose buckets
+  ``PredictEngine.warmup`` pre-compiled, budgeted at 0.
 """
 from __future__ import annotations
 
@@ -62,6 +74,36 @@ def measure() -> dict:
         for _ in range(3):
             booster.predict(X)
     counts["predict_warm_repeat"] = int(n[0])
+
+    # leaf-wise grower: a different step program than the depthwise default
+    with jtu.count_jit_and_pmap_lowerings() as n:
+        lgb.train({**params, "grow_policy": "lossguide"}, train_set,
+                  num_boost_round=3)
+    counts["train_3_iters_lossguide"] = int(n[0])
+
+    # warmed non-gbdt boosters: 3 warmup iterations, then two extra
+    # update() calls must lower NOTHING (budget 0). skip_drop=0 makes every
+    # DART iteration take the drop/normalize path, so the warmup sees it.
+    for boosting, extra in (("dart", {"skip_drop": 0.0, "drop_rate": 0.5}),
+                            ("goss", {}),
+                            ("rf", {"bagging_freq": 1,
+                                    "bagging_fraction": 0.8})):
+        bst = lgb.train({**params, "boosting": boosting, **extra},
+                        train_set, num_boost_round=3)
+        with jtu.count_jit_and_pmap_lowerings() as n:
+            bst.update()
+            bst.update()
+        counts[f"train_warm_extra2_{boosting}"] = int(n[0])
+
+    # serving path: predicts at row counts whose buckets warmup()
+    # pre-compiled must reuse the warmed executables (budget 0)
+    booster.predict(X[:4])              # materialize the cached engine
+    engine = booster._predict_engine
+    engine.warmup(sizes=(1, 100))
+    with jtu.count_jit_and_pmap_lowerings() as n:
+        engine.predict(X[:1])
+        engine.predict(X[:100])
+    counts["predict_engine_warm"] = int(n[0])
 
     return counts
 

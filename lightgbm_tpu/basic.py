@@ -139,6 +139,31 @@ class Dataset:
             self._num_data = arr_shape[0]
             self._num_features_raw = arr_shape[1] if len(arr_shape) > 1 else 1
 
+    # ---- device bin matrix + cached transpose ----
+    @property
+    def bins(self):
+        """Device uint8 bin matrix [N, F_used] (row-sharded: [N_pad, F_used])."""
+        return self._bins_dev
+
+    @bins.setter
+    def bins(self, value):
+        # every assignment (construct / append / subset / add_features_from)
+        # drops the transposed cache with it — the two can never disagree
+        self._bins_dev = value
+        self._bins_T = None
+
+    @property
+    def bins_T(self):
+        """Device-resident transposed bin matrix [F_used, N], built lazily on
+        first use and cached. The Pallas histogram kernels consume
+        feature-major rows; before this cache every grower call rebuilt
+        ``bins.T`` inside its traced step — a full-matrix HBM transpose per
+        tree. Invalidated by the ``bins`` setter whenever the matrix
+        changes."""
+        if self._bins_T is None:
+            self._bins_T = self.bins.T
+        return self._bins_T
+
     # ---- construction ----
     def _resolve_categorical(self, ncols: int, columns) -> List[int]:
         cf = self.categorical_feature
@@ -908,6 +933,26 @@ class Dataset:
         return self
 
 
+def booster_class(boosting: str):
+    """Boosting-variant trainer class for a config string (reference: the
+    factory in boosting.cpp:35). Shared by Booster construction and the AOT
+    prewarm worker (prewarm.py), which must build the SAME trainer class to
+    produce an executable the real trainer can adopt."""
+    b = str(boosting).lower()
+    if b in ("gbdt", "gbrt"):
+        return GBDT
+    if b == "dart":
+        from .models.dart import DART
+        return DART
+    if b == "goss":
+        from .models.goss import GOSS
+        return GOSS
+    if b in ("rf", "random_forest"):
+        from .models.rf import RF
+        return RF
+    log.fatal(f"unknown boosting type {boosting}")
+
+
 class Booster:
     """Trained/training model handle (reference: lightgbm.Booster, basic.py:1666)."""
 
@@ -969,20 +1014,7 @@ class Booster:
         objective = create_objective(conf.objective, conf)
         metric_names = conf.metric or [default_metric_for_objective(conf.objective)]
         metrics = create_metrics(metric_names, conf, conf.objective)
-        boosting = conf.boosting.lower()
-        if boosting in ("gbdt", "gbrt"):
-            cls = GBDT
-        elif boosting == "dart":
-            from .models.dart import DART
-            cls = DART
-        elif boosting == "goss":
-            from .models.goss import GOSS
-            cls = GOSS
-        elif boosting in ("rf", "random_forest"):
-            from .models.rf import RF
-            cls = RF
-        else:
-            log.fatal(f"unknown boosting type {conf.boosting}")
+        cls = booster_class(conf.boosting)
         self._gbdt = cls(conf, train_set, objective, metrics)
         self._objective = objective
 

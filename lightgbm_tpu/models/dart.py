@@ -21,8 +21,9 @@ from .gbdt import GBDT
 class DART(GBDT):
     name = "dart"
 
-    def __init__(self, config, train_set, objective, metrics=None):
-        super().__init__(config, train_set, objective, metrics)
+    def __init__(self, config, train_set, objective, metrics=None,
+                 quiet: bool = False):
+        super().__init__(config, train_set, objective, metrics, quiet=quiet)
         self.drop_rate = config.drop_rate
         self.max_drop = config.max_drop
         self.skip_drop = config.skip_drop

@@ -88,7 +88,10 @@ class GBDT:
         n = train_set.num_data
         k = self.num_tree_per_iteration
         shape = (n,) if k == 1 else (n, k)
-        self.train_score = jnp.zeros(shape, dtype=jnp.float32)
+        # device_put of host zeros, not jnp.zeros: the trainer sits on the
+        # compile-budget probe's train path and an eager jnp.zeros lowers a
+        # one-op program (LOWERING_BUDGET.json train_3_iters)
+        self.train_score = jax.device_put(np.zeros(shape, dtype=np.float32))
         if train_set.init_score is not None:
             self.train_score = self.train_score + jnp.asarray(
                 train_set.init_score, dtype=jnp.float32).reshape(shape)
@@ -607,7 +610,8 @@ class GBDT:
         frac = self.config.feature_fraction
         if frac >= 1.0:
             if not hasattr(self, "_fmask_ones"):
-                self._fmask_ones = jnp.ones(f, dtype=bool)
+                # device_put (no one-op lowering) — see __init__ train_score
+                self._fmask_ones = jax.device_put(np.ones(f, dtype=bool))
             return self._fmask_ones
         k = max(1, int(round(f * frac)))
         idx = self._feat_rng.choice(f, k, replace=False)
@@ -628,7 +632,10 @@ class GBDT:
                 init = self.objective.boost_from_score()
                 if abs(init) > K_EPSILON:
                     self.init_scores[cls] = init
-            shift = jnp.asarray(self.init_scores, dtype=jnp.float32)
+            # host f32 scalars/rows: a device shift vector costs 4 one-op
+            # lowerings (asarray + slice + squeeze + add) on the probe's
+            # train path; the numpy operand folds into the single add
+            shift = np.asarray(self.init_scores, dtype=np.float32)
             if k == 1:
                 self.train_score = self.train_score + shift[0]
                 self.valid_scores = [s + shift[0] for s in self.valid_scores]
@@ -651,6 +658,54 @@ class GBDT:
     # ---- fused single-dispatch iteration (TPU: python dispatch + host syncs cost
     # >100ms through tunneled runtimes; the whole gradients->grow->score-update
     # chain runs as ONE jitted call) ----
+    def _use_bt(self) -> bool:
+        """Whether the step feeds the Dataset's cached [F, N] transposed bin
+        matrix to the growers. Serial Pallas trainers only: the per-tree
+        ``bins.T`` rebuild inside the growers was a full-matrix HBM
+        transpose per tree; dp/fp shard the matrix and keep the old path.
+        A mesh-native row-shard plan also opts out: transposing the
+        row-sharded matrix would be an all-to-all reshard."""
+        from ..ops.histogram import pick_impl
+        return (not self._dp and not self._fp
+                and getattr(self, "_plan", None) is None
+                and pick_impl(self.gp.hist_impl) == "pallas")
+
+    def _fused_front(self):
+        """(spec, aux_rows) for the fused grad+quant+hist0 front
+        (ops/histogram.grad_quant_hist0), or (None, None) when any gate
+        fails.
+
+        Gates: single-model-per-iteration auto-gradient training on the
+        serial depthwise quantized grower (no lean tiling, CEGB or forced
+        splits — those paths read materialized g/h), a built-in objective
+        that advertises an in-register gradient replica
+        (ObjectiveFunction.fused_grad_spec), the Pallas histogram impl, and
+        an [F*B] accumulator that fits the fused kernel's VMEM row budget.
+        Anything else keeps the unfused gradients -> make_quant -> hist0
+        chain, which the fused kernel is bit-identical to by construction."""
+        cached = getattr(self, "_fused_front_cache", None)
+        if cached is not None:
+            return cached
+        res = (None, None)
+        gp = self.gp
+        obj = self.objective
+        if (self.num_tree_per_iteration == 1 and obj is not None
+                and self.config.grow_policy == "depthwise"
+                and gp.quant and gp.lean_ft <= 0
+                and not self._dp and not self._fp
+                and getattr(self, "_plan", None) is None
+                and self._cegb_dev is None and self._forced_dev is None):
+            from ..ops.histogram import pick_impl
+            from ..ops.pallas_hist import _ACC_ROWS_MAX
+            F = int(self.train_set.num_features)
+            if (pick_impl(gp.hist_impl) == "pallas"
+                    and F * int(gp.max_bin) <= _ACC_ROWS_MAX):
+                fs = obj.fused_grad_spec()
+                if fs is not None:
+                    res = fs
+        self._fused_front_cache = res
+        return res
+
     def _make_one_class(self, custom: bool):
         """Build the traced grow-one-class-tree closure shared by the
         per-iteration fused step and the K-iteration block step."""
@@ -671,6 +726,15 @@ class GBDT:
         depthwise_fused = self.config.grow_policy == "depthwise"
 
         use_cegb = depthwise_fused and self._cegb_dev is not None
+
+        # fused grad+quant+hist0 front: the auto-gradient serial depthwise
+        # quantized path recomputes gradients in-register inside the
+        # root-histogram kernel (ops/pallas_hist.grad_quant_hist0_pallas)
+        # instead of materializing g/h to HBM first — see _fused_front
+        fused_spec = None if custom else self._fused_front()[0]
+        if fused_spec is not None:
+            import dataclasses
+            gp = dataclasses.replace(gp, fused_obj=fused_spec)
 
         # ---- grow-call variants: serial / data-parallel (shard_map) /
         # feature-parallel (sharding annotations). The distributed learners
@@ -718,7 +782,7 @@ class GBDT:
                     check_vma=False)
 
                 def do_grow(bins, gw, hw, cw, num_bins, na_bin, fmask, qs,
-                            cegb_st):
+                            cegb_st, bt=None, fused=None):
                     if pad_rows:
                         gw = jnp.pad(gw, (0, pad_rows))
                         hw = jnp.pad(hw, (0, pad_rows))
@@ -747,7 +811,7 @@ class GBDT:
                     check_vma=False)
 
                 def do_grow(bins, gw, hw, cw, num_bins, na_bin, fmask, qs,
-                            cegb_st):
+                            cegb_st, bt=None, fused=None):
                     if pad_rows:
                         gw = jnp.pad(gw, (0, pad_rows))
                         hw = jnp.pad(hw, (0, pad_rows))
@@ -765,7 +829,7 @@ class GBDT:
             fpad, fp_bundle = self._fp_pad, self._fp_bundle
 
             def do_grow(bins, gw, hw, cw, num_bins, na_bin, fmask, qs,
-                        cegb_st):
+                        cegb_st, bt=None, fused=None):
                 if fpad:
                     fmask = jnp.pad(fmask, (0, fpad), constant_values=False)
                 kw2 = {"qseed": qs} if gp_fp.ff_bynode < 1.0 else {}
@@ -774,11 +838,15 @@ class GBDT:
                 return tree, leaf_id, cegb_st
         else:
             def do_grow(bins, gw, hw, cw, num_bins, na_bin, fmask, qs,
-                        cegb_st):
+                        cegb_st, bt=None, fused=None):
                 kw = {"forced": forced} if forced is not None else {}
                 if ((depthwise_fused and gp.quant) or gp.ff_bynode < 1.0
                         or gp.split.extra_trees):
                     kw["qseed"] = qs
+                if bt is not None:
+                    kw["bins_T"] = bt
+                if fused is not None:
+                    kw["fused"] = fused
                 if use_cegb:
                     # CEGB bookkeeping threads across the k class trees of one
                     # iteration (and across iterations via the returned state)
@@ -792,7 +860,8 @@ class GBDT:
                 return tree, leaf_id, cegb_st
 
         def one_class(new_score, cegb_st, grad, hess, cls, bins, num_bins,
-                      na_bin, fmask, bag_mask, shrink, qseed, titer):
+                      na_bin, fmask, bag_mask, shrink, qseed, titer,
+                      bt=None, aux=None):
             """Grow and apply one class tree; cls may be a Python int
             (unrolled small-k path) or a traced i32 (scan path)."""
             if k == 1:
@@ -802,10 +871,16 @@ class GBDT:
             else:
                 g = jnp.take(grad, cls, axis=1)
                 h = jnp.take(hess, cls, axis=1)
+            # fused front: the grower recomputes this class' gradients
+            # in-register from (score, aux); g/h stay tracer dummies whose
+            # zero-filled products XLA dead-code-eliminates
+            fused = ((new_score, aux, bag_mask)
+                     if fused_spec is not None else None)
             tree, leaf_id, cegb_st = do_grow(
                 bins, g * bag_mask, h * bag_mask,
-                (bag_mask > 0).astype(g.dtype),
-                num_bins, na_bin, fmask, qseed * k + cls, cegb_st)
+                (bag_mask > 0).astype(jnp.float32),
+                num_bins, na_bin, fmask, qseed * k + cls, cegb_st,
+                bt, fused)
             # average-output mode (RF) never renews: its slow path skips
             # _finish_tree's renewal too (rf.py RF._finish_tree), and the
             # L1-family renewal semantics assume an additive boosted score
@@ -836,11 +911,17 @@ class GBDT:
         obj = self.objective
         one_class = self._make_one_class(custom)
         nf = self._nf_policy
+        use_bt = self._use_bt()
+        fused_spec = None if custom else self._fused_front()[0]
 
         def step(bins, num_bins, na_bin, score, fmask, bag_mask, grad, hess,
-                 shrink, qseed, titer, cegb_st):
-            if not custom:
+                 shrink, qseed, titer, cegb_st, bins_t, aux):
+            bt = bins_t if use_bt else None
+            if not custom and fused_spec is None:
                 grad, hess = obj.get_gradients(score)
+            # else fused front: the grower derives gradients from
+            # (score, aux) in-register — the full-N g/h arrays are never
+            # materialized (two HBM round-trips fewer per iteration)
             if k <= 8:
                 # small k: Python-unrolled class trees (static cls indexing)
                 trees = []
@@ -848,7 +929,8 @@ class GBDT:
                 for cls in range(k):
                     tree, leaf_id, new_score, cegb_st = one_class(
                         new_score, cegb_st, grad, hess, cls, bins, num_bins,
-                        na_bin, fmask, bag_mask, shrink, qseed, titer)
+                        na_bin, fmask, bag_mask, shrink, qseed, titer,
+                        bt, aux)
                     trees.append((tree, leaf_id))
             else:
                 # large k (VERDICT r4 weak #4): ONE grower compilation scanned
@@ -859,7 +941,8 @@ class GBDT:
                     new_score, cegb_c = carry
                     tree, leaf_id, new_score, cegb_c = one_class(
                         new_score, cegb_c, grad, hess, cls, bins, num_bins,
-                        na_bin, fmask, bag_mask, shrink, qseed, titer)
+                        na_bin, fmask, bag_mask, shrink, qseed, titer,
+                        bt, aux)
                     return (new_score, cegb_c), (tree, leaf_id)
                 (new_score, cegb_st), trees = jax.lax.scan(
                     body, (score, cegb_st), jnp.arange(k, dtype=jnp.int32))
@@ -964,12 +1047,16 @@ class GBDT:
     def _fused_step(self, grad, hess):
         custom = grad is not None
         key = "_step_custom" if custom else "_step_auto"
-        if not custom and self._prewarm_handle is not None:
+        if self._prewarm_handle is not None:
             # the before-first-dispatch barrier: join the background compile
-            # and take its executable (None on spec mismatch/error)
+            # and take its executable (None on spec mismatch/error). The
+            # handle records whether it compiled the custom- or auto-gradient
+            # step (GOSS/RF prewarm the custom one); adopt() rejects a
+            # mismatch, so a custom-step executable never sees auto args
             from .. import prewarm as _prewarm
             handle, self._prewarm_handle = self._prewarm_handle, None
-            self._step_aot = _prewarm.adopt(handle, self)
+            self._step_aot = _prewarm.adopt(handle, self, custom=custom)
+            self._step_aot_custom = custom
         ts = self.train_set
         n = ts.num_data
         if self._bag_mask is not None:
@@ -989,19 +1076,23 @@ class GBDT:
                                         self._fp_na_bin)
         else:
             bins_arg, nb_arg, na_arg = ts.bins, ts.num_bins_dev, ts.na_bin_dev
+        fused_spec, fused_aux = (None, None) if custom else self._fused_front()
+        bt_in = ts.bins_T if self._use_bt() else dummy
+        aux_in = fused_aux if fused_spec is not None else dummy
         args = (bins_arg, nb_arg, na_arg,
                 self.train_score, self._feature_mask(), bag,
                 grad if custom else dummy,
                 hess if custom else dummy,
                 jnp.float32(shrink), jnp.int32(self.iter_),
-                jnp.float32(self.iter_ + 1), cegb_in)
+                jnp.float32(self.iter_ + 1), cegb_in, bt_in, aux_in)
         def _dispatch():
             if self._dp:
                 # chaos point: host side of the fused-step dispatch whose
                 # traced body carries the per-level histogram psum — inside
                 # the retried callable so a recovery attempt re-hits it
                 faults.fault_point("hist_allreduce")
-            if not custom and self._step_aot is not None:
+            if (self._step_aot is not None
+                    and custom == getattr(self, "_step_aot_custom", False)):
                 try:
                     # prewarmed executables are dispatched directly — AOT
                     # compilation never enters the jit wrapper's cache, so
@@ -1335,6 +1426,8 @@ class GBDT:
                 qkw = ({"qseed": jnp.int32(self.iter_ * k + cls)}
                        if (self.gp.quant or self.gp.ff_bynode < 1.0
                            or self.gp.split.extra_trees) else {})
+                if self._use_bt():
+                    qkw["bins_T"] = ts.bins_T
                 if self._cegb_dev is not None:
                     tree_dev, leaf_id, self._cegb_dev = grow_tree_depthwise(
                         ts.bins, gw, hw, cw, ts.num_bins_dev, ts.na_bin_dev,
@@ -1349,6 +1442,8 @@ class GBDT:
                 qkw2 = ({"qseed": jnp.int32(self.iter_ * k + cls)}
                         if (self.gp.ff_bynode < 1.0
                             or self.gp.split.extra_trees) else {})
+                if self._use_bt():
+                    qkw2["bins_T"] = ts.bins_T
                 tree_dev, leaf_id = grow_tree(ts.bins, gw, hw, cw,
                                               ts.num_bins_dev, ts.na_bin_dev,
                                               fmask, self.gp,

@@ -18,9 +18,11 @@ class GOSS(GBDT):
     name = "goss"
     _needs_grad_for_bag = True
 
-    def __init__(self, config, train_set, objective, metrics=None):
-        super().__init__(config, train_set, objective, metrics)
-        if config.bagging_freq > 0 and config.bagging_fraction < 1.0:
+    def __init__(self, config, train_set, objective, metrics=None,
+                 quiet: bool = False):
+        super().__init__(config, train_set, objective, metrics, quiet=quiet)
+        if not quiet and config.bagging_freq > 0 \
+                and config.bagging_fraction < 1.0:
             log.warning("cannot use bagging in GOSS")
         self.top_rate = config.top_rate
         self.other_rate = config.other_rate

@@ -23,12 +23,13 @@ class RF(GBDT):
     # running-average score update plugs in via _apply_tree_delta
     _supports_fused = True
 
-    def __init__(self, config, train_set, objective, metrics=None):
+    def __init__(self, config, train_set, objective, metrics=None,
+                 quiet: bool = False):
         if not (config.bagging_freq > 0 and
                 (config.bagging_fraction < 1.0 or config.feature_fraction < 1.0)):
             log.fatal("RF mode requires bagging (bagging_freq > 0 and "
                       "bagging_fraction < 1.0) or feature_fraction < 1.0")
-        super().__init__(config, train_set, objective, metrics)
+        super().__init__(config, train_set, objective, metrics, quiet=quiet)
         self._const_score = None
         self._const_gh = None
 

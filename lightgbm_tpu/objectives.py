@@ -62,6 +62,16 @@ class ObjectiveFunction:
         RenewTreeOutput, regression_objective.hpp). Returns None if not needed."""
         return None
 
+    def fused_grad_spec(self):
+        """Static spec for the fused grad+quant+hist kernel front, or None.
+
+        When an objective's gradients are a cheap elementwise function of
+        (score, one per-row constant), the Pallas path can recompute them
+        in-register instead of materializing [N] grad/hess rows
+        (ops/pallas_hist._grad_rows replays the spec bit-exactly). Returns
+        (spec_tuple, aux_rows) — spec members must be hashable statics."""
+        return None
+
     def __str__(self):
         return self.name
 
@@ -83,6 +93,13 @@ class RegressionL2(ObjectiveFunction):
         grad = score - self.label
         hess = jnp.ones_like(score)
         return _weighted(grad, hess, self.weight)
+
+    def fused_grad_spec(self):
+        # subclasses (L1/Huber/...) override get_gradients, so only the
+        # exact L2 objective may advertise the fused front
+        if type(self) is not RegressionL2 or self.weight is not None:
+            return None
+        return ("l2",), self.label
 
     def boost_from_score(self):
         if self.weight is None:
@@ -274,6 +291,13 @@ class Binary(ObjectiveFunction):
         grad = -t * resp * self.sigmoid * lw
         hess = self.sigmoid * self.sigmoid * resp * (1.0 - resp) * lw
         return _weighted(grad, hess, self.weight)
+
+    def fused_grad_spec(self):
+        if type(self) is not Binary or self.weight is not None:
+            return None
+        return (("logloss", float(self.sigmoid),
+                 float(self.label_weight_pos), float(self.label_weight_neg)),
+                self.label_pos)
 
     def boost_from_score(self):
         if self._cnt_pos <= 0 or self._cnt_neg <= 0:

@@ -71,6 +71,13 @@ class GrowParams:
     # reference's entire ReduceScatter+Allgather machinery (network.cpp) becomes
     # these two collectives; split selection is computed replicated on all shards.
     axis_name: str = ""
+    # static spec of a built-in objective whose gradients the depthwise
+    # grower recomputes in-register (ObjectiveFunction.fused_grad_spec):
+    # ("l2",) or ("logloss", sigmoid, lw_pos, lw_neg). When set, the grower
+    # takes fused=(score, aux, bag) row inputs and runs the fused
+    # grad+quant+hist0 front instead of reading materialized g/h/c —
+    # two fewer full-N HBM round-trips per iteration. None = unfused.
+    fused_obj: tuple = None
 
 
 def _psum(x, gp: "GrowParams"):
@@ -148,7 +155,7 @@ def _allow_depth(depth, gp: GrowParams):
 def grow_tree(bins: jnp.ndarray, g: jnp.ndarray, h: jnp.ndarray, c: jnp.ndarray,
               num_bins: jnp.ndarray, na_bin: jnp.ndarray,
               feature_mask: jnp.ndarray, gp: GrowParams, bundle=None,
-              forced=None, qseed=None
+              forced=None, qseed=None, bins_T=None
               ) -> Tuple[TreeArrays, jnp.ndarray]:
     """Grow one tree.
 
@@ -196,9 +203,14 @@ def grow_tree(bins: jnp.ndarray, g: jnp.ndarray, h: jnp.ndarray, c: jnp.ndarray,
             jax.random.fold_in(jax.random.PRNGKey(sp.extra_seed), base), tag)
 
     leaf_id = jnp.zeros(n, dtype=jnp.int32)
-    # pallas kernels read a transposed bin matrix; build it ONCE per tree (XLA
-    # CSEs it across all histogram passes inside this jit)
-    bins_T = bins.T if H.pick_impl(gp.hist_impl) == "pallas" else None
+    # pallas kernels read a transposed bin matrix: use the Dataset's cached
+    # device-resident copy when the caller passes one (no per-tree N*F HBM
+    # transpose), else build it once per tree (XLA CSEs it across all
+    # histogram passes inside this jit)
+    if H.pick_impl(gp.hist_impl) != "pallas":
+        bins_T = None
+    elif bins_T is None:
+        bins_T = bins.T
     hist0 = _psum(H.hist_leaf(bins, g, h, c, B, gp.hist_impl, bins_T=bins_T),
                   gp)                                                  # [3, F, B]
     g0, h0, c0 = hist0[0, 0].sum(), hist0[1, 0].sum(), hist0[2, 0].sum()

@@ -41,9 +41,10 @@ _OOB = 1 << 20  # out-of-bounds scatter index (dropped with mode="drop")
 # minimum static slot width for unrolled levels on the PALLAS path: the
 # fused pass is latency-bound below S=32 (flat 17-22 ms, PERF_NOTES cost
 # table), so levels 0..4 share one padded kernel variant instead of
-# compiling five (S=1,2,4,8,16) that run no faster. At L=255 this cuts the
-# distinct Mosaic variants per grower from 8 to 3 ({32, 64, 127}). The XLA
-# fallback impl pays real per-slot FLOPs, so it is not floored.
+# compiling five (S=1,2,4,8,16) that run no faster. Widths above the floor
+# snap to pallas_hist.MASTER_SLOT_WIDTHS — at L=255 the per-grower variants
+# are {32, 127} (the 64-wide level joins the 127 group). The XLA fallback
+# impl pays real per-slot FLOPs, so it is not floored.
 _SLOT_FLOOR = 32
 
 
@@ -179,8 +180,20 @@ def _run_level_schedule(state, level, L, max_levels, n_unroll, MAX_SLOTS,
     zero iterations), and the level index reaches the body as a traced
     i32 either way (it only feeds ``jax.random.fold_in``).
     """
-    widths = [min(MAX_SLOTS, max(2 ** k, slot_floor))
-              for k in range(n_unroll)]
+    if slot_floor > 1:
+        # pallas path: floor every unrolled width to the master slot-width
+        # set, so the depthwise default, lean and leaf-wise growers share one
+        # compiled kernel program per master width instead of one per 2^k.
+        # Over-wide S never changes selection: level k has <= 2^k candidate
+        # leaves <= the un-floored width, so `rank < min(budget, SLOTS)`
+        # binds identically (see the schedule comment in grow_tree_depthwise)
+        from .pallas_hist import floor_slot_width
+        widths = [floor_slot_width(max(min(2 ** k, MAX_SLOTS), slot_floor),
+                                   MAX_SLOTS)
+                  for k in range(n_unroll)]
+    else:
+        widths = [min(MAX_SLOTS, max(2 ** k, slot_floor))
+                  for k in range(n_unroll)]
     groups = []   # [width, first level, one-past-last level]
     for k, w in enumerate(widths):
         if groups and groups[-1][0] == w:
@@ -225,7 +238,7 @@ def grow_tree_depthwise(bins: jnp.ndarray, g: jnp.ndarray, h: jnp.ndarray,
                         c: jnp.ndarray, num_bins: jnp.ndarray,
                         na_bin: jnp.ndarray, feature_mask: jnp.ndarray,
                         gp: GrowParams, bundle=None, forced=None, qseed=None,
-                        cegb=None):
+                        cegb=None, bins_T=None, fused=None):
     """Grow one tree level-wise.
 
     bins: [N, F] uint8; g/h/c: [N] f32 grad/hess/in-bag count channels (already
@@ -235,6 +248,13 @@ def grow_tree_depthwise(bins: jnp.ndarray, g: jnp.ndarray, h: jnp.ndarray,
     (TreeArrays, leaf_id [N] i32), plus the updated ``cegb`` CEGBState when one
     is passed (gp.split.has_cegb; penalties recomputed fresh each level, so the
     reference's stale-cache fixups in UpdateLeafBestSplits are unnecessary).
+
+    ``bins_T``: optional cached [F, N] transpose (Dataset.bins_T) — skips the
+    per-tree transpose on the pallas path. ``fused``: (score, aux, bag) row
+    inputs for the fused grad+quant+hist0 front, valid only with
+    gp.fused_obj set and gp.quant on; the g/h/c arguments are then unused
+    placeholders (the quantized channels and all histogram passes derive
+    from the fused front, bit-identical to the unfused chain).
     """
     n, f = bins.shape
     L, B = gp.num_leaves, gp.max_bin
@@ -249,22 +269,40 @@ def grow_tree_depthwise(bins: jnp.ndarray, g: jnp.ndarray, h: jnp.ndarray,
     # cap+1 = 129 -> 387 -> 512 lanes (+33% MXU on the deepest level)
     MAX_SLOTS = max(1, L // 2)
 
-    # pallas kernels read a transposed bin matrix; build it ONCE per tree (XLA
-    # CSEs it across all level passes inside this jit)
+    # pallas kernels read a transposed bin matrix: prefer the Dataset's
+    # cached device-resident copy, else build it once per tree (XLA CSEs it
+    # across all level passes inside this jit)
     use_pallas = H.pick_impl(gp.hist_impl) == "pallas"
-    bins_T = bins.T if use_pallas else None
-    # int8 quantized channels, built once per tree; per-shard scales are fine
-    # under data-parallel because every histogram is dequantized to f32 before
-    # the psum (each shard contributes real-valued mass)
-    quant = (H.make_quant(g, h, c, qseed, const_hess=gp.const_hess)
-             if gp.quant else None)
-    # (The segment-packed level-pass experiment that used to live here is
-    # archived on branch `archive/packed-levels`: row compaction measured
-    # 10-24x slower on this runtime — per-level XLA gathers dominate. See
-    # docs/PERF_NOTES.md "negative results".)
-    hist0 = _psum(H.hist_leaf(bins, g, h, c, B, gp.hist_impl, bins_T=bins_T,
-                              quant=quant),
-                  gp)                                                # [3, F, B]
+    if not use_pallas:
+        bins_T = None
+    elif bins_T is None:
+        bins_T = bins.T
+    if fused is not None:
+        # fused grad+quant+hist0 front: gradients recomputed in-register
+        # from (score, aux, bag), never materialized as [N] rows — the
+        # gradient write, two quantize reads and the root-histogram read
+        # collapse into one pass. Per-shard scales remain fine under
+        # data-parallel (histograms dequantize to f32 before the psum),
+        # exactly as with make_quant below.
+        assert gp.fused_obj is not None and gp.quant and cegb is None
+        f_score, f_aux, f_bag = fused
+        quant, hist0 = H.grad_quant_hist0(
+            bins, f_score, f_aux, f_bag, qseed, gp.fused_obj, B,
+            const_hess=gp.const_hess, impl=gp.hist_impl, bins_T=bins_T)
+        hist0 = _psum(hist0, gp)
+    else:
+        # int8 quantized channels, built once per tree; per-shard scales are
+        # fine under data-parallel because every histogram is dequantized to
+        # f32 before the psum (each shard contributes real-valued mass)
+        quant = (H.make_quant(g, h, c, qseed, const_hess=gp.const_hess)
+                 if gp.quant else None)
+        # (The segment-packed level-pass experiment that used to live here is
+        # archived on branch `archive/packed-levels`: row compaction measured
+        # 10-24x slower on this runtime — per-level XLA gathers dominate. See
+        # docs/PERF_NOTES.md "negative results".)
+        hist0 = _psum(H.hist_leaf(bins, g, h, c, B, gp.hist_impl,
+                                  bins_T=bins_T, quant=quant),
+                      gp)                                            # [3, F, B]
     g0 = hist0[0, 0].sum()
     h0 = hist0[1, 0].sum()
     c0 = hist0[2, 0].sum()
@@ -619,12 +657,26 @@ def grow_tree_depthwise(bins: jnp.ndarray, g: jnp.ndarray, h: jnp.ndarray,
         # leaf renewal from EXACT sums (quantized-training paper: splits
         # tolerate int8 gains, leaf outputs should not; reference analog:
         # exact LeafSplits aggregates, leaf_splits.hpp:20)
-        from .pallas_hist import leaf_sums_pallas
+        from .pallas_hist import leaf_sums_grad_pallas, leaf_sums_pallas
         # interpret only where Mosaic can't compile (CPU backend) — keying on
         # hist_impl would run the interpreter inside the jitted tree on TPU
         interp = jax.default_backend() == "cpu"
-        sums = _psum(leaf_sums_pallas(g, h, c, state.leaf_id, L,
-                                      interpret=interp), gp)
+        if fused is not None and use_pallas:
+            sums = _psum(leaf_sums_grad_pallas(f_score, f_aux, f_bag,
+                                               state.leaf_id, gp.fused_obj,
+                                               L, interpret=interp), gp)
+        elif fused is not None:
+            # XLA fallback: rebuild the exact rows the unfused path would
+            # have passed in (bit-identical f32 ops, see _grad_rows)
+            from .pallas_hist import _grad_rows
+            fg_, fh_ = _grad_rows(gp.fused_obj, f_score, f_aux)
+            sums = _psum(leaf_sums_pallas(fg_ * f_bag, fh_ * f_bag,
+                                          (f_bag > 0).astype(jnp.float32),
+                                          state.leaf_id, L,
+                                          interpret=interp), gp)
+        else:
+            sums = _psum(leaf_sums_pallas(g, h, c, state.leaf_id, L,
+                                          interpret=interp), gp)
         eg, eh, ec = sums[0], sums[1], sums[2]
         w = leaf_output(eg, eh, sp)
         if sp.has_monotone:
@@ -703,7 +755,7 @@ def _slice_bundle(bundle, lo, hi):
 @partial(jax.jit, static_argnames=("gp",))
 def grow_tree_depthwise_lean(bins: jnp.ndarray, g, h, c, num_bins, na_bin,
                              feature_mask, gp: GrowParams, bundle=None,
-                             forced=None, qseed=None, cegb=None):
+                             forced=None, qseed=None, cegb=None, bins_T=None):
     """Depthwise growth under a histogram-memory budget (reference analog:
     HistogramPool, feature_histogram.hpp:687 + serial_tree_learner.cpp:39-52
     sizing — here the budget bounds LIVE histogram tiles instead of caching
@@ -737,7 +789,10 @@ def grow_tree_depthwise_lean(bins: jnp.ndarray, g, h, c, num_bins, na_bin,
     MAX_SLOTS = max(1, L // 2)
 
     use_pallas = H.pick_impl(gp.hist_impl) == "pallas"
-    bins_T = bins.T if use_pallas else None
+    if not use_pallas:
+        bins_T = None
+    elif bins_T is None:
+        bins_T = bins.T
     # quantization mirrors hist_routed exactly (histogram.py:433-436): the
     # q8 kernel on the pallas path, per-row dequantized channels elsewhere —
     # so lean and default growers see the SAME histogram numbers per impl

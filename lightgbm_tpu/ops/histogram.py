@@ -404,6 +404,39 @@ def dequant_rows(quant: QuantChannels):
     return g, h, c
 
 
+def grad_quant_hist0(bins, score, aux, bag, seed, spec, num_bins,
+                     const_hess: bool = False, impl: str = "auto",
+                     bins_T=None):
+    """Fused per-iteration front: objective gradients + SR quantization +
+    root histogram in one pass.
+
+    ``spec`` is an objective's static ``fused_grad_spec()`` tuple (("l2",) or
+    ("logloss", sigmoid, lw_pos, lw_neg)); ``aux`` its per-row constant
+    (label for L2, label_pos for logloss). Returns (QuantChannels, hist0
+    [3, F, B] f32) — bit-identical to get_gradients -> mask-by-bag ->
+    make_quant -> hist_leaf on every backend: the Pallas kernel replays the
+    same f32 ops and dither hash, and the non-Pallas fallback below IS that
+    unfused chain."""
+    impl = pick_impl(impl)
+    from .pallas_hist import _ACC_ROWS_MAX, _grad_rows, grad_quant_hist0_pallas
+    f = bins.shape[1]
+    if impl == "pallas" and f * num_bins <= _ACC_ROWS_MAX:
+        interp = jax.default_backend() == "cpu"
+        bt = bins_T if bins_T is not None else bins.T
+        gq, hq, cq, sg, sh, hist0 = grad_quant_hist0_pallas(
+            bt, score, aux, bag, seed, spec, num_bins,
+            const_hess=const_hess, interpret=interp)
+        return QuantChannels(gq, hq, cq, sg, sh), hist0
+    grad, hess = _grad_rows(spec, score, aux)
+    g = grad * bag
+    h = hess * bag
+    c = (bag > 0).astype(jnp.float32)
+    quant = make_quant(g, h, c, seed, const_hess=const_hess)
+    hist0 = hist_leaf(bins, g, h, c, num_bins, impl=impl, bins_T=bins_T,
+                      quant=quant)
+    return quant, hist0
+
+
 # ---------------------------------------------------------------------------
 # dispatch
 # ---------------------------------------------------------------------------

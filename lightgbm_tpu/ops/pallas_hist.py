@@ -39,6 +39,22 @@ _CHUNK = 1024          # rows per grid step (onehot block [F*B, C] bf16 ~3.7MB)
 _CHUNK_Q8 = 4096
 _ACC_ROWS_MAX = 2048   # Fg*B cap: keeps the f32 accumulator block <= ~6.3MB
 
+# Master slot-width set: every Pallas level pass floors its slot count to one
+# of these widths, so the depthwise default grower, the lean grower and the
+# replay megapass all reuse the same traced kernel programs — fewer distinct
+# widths = fewer lowerings. Over-wide S is free for correctness: extra slots
+# accumulate nothing (no row routes into them) and split selection binds on
+# the per-level budget, not the kernel width.
+MASTER_SLOT_WIDTHS = (32, 128, 512)
+
+
+def floor_slot_width(needed: int, max_slots: int) -> int:
+    """Smallest master width >= needed, capped at max_slots."""
+    for w in MASTER_SLOT_WIDTHS:
+        if w >= needed:
+            return min(w, max_slots)
+    return max_slots
+
 
 def _kernel(bins_ref, g_ref, h_ref, c_ref, slot_ref, out_ref, *,
             fg: int, b: int, s: int, chunk: int):
@@ -342,7 +358,8 @@ def hist_pallas_q8(bins_T: jnp.ndarray, gq: jnp.ndarray, hq: jnp.ndarray,
 
 
 def _kernel_q8_fused(*refs, f: int, b: int, s: int, l: int, chunk: int,
-                     has_cat: bool, nch: int = 3, swar: bool = False):
+                     has_cat: bool, nch: int = 3, swar: bool = False,
+                     d: int = 1):
     """Fused route + int8 histogram for ONE feature group (F*B <= block cap).
 
     Per level the two-pass scheme reads the bin matrix twice (route kernel,
@@ -351,10 +368,18 @@ def _kernel_q8_fused(*refs, f: int, b: int, s: int, l: int, chunk: int,
     histogram floor of ~15 ms. This kernel routes the chunk in-register and
     feeds the slot straight into the weight mask — one bins read, one launch.
 
-    refs: bins [F, C] u8; gq/hq/cq [C] i8; lid [C] i32; tabs [8, L] f32
-    (feat, thr, dleft, new_leaf, slot_left, slot_right, is_cat, _);
-    nab [F, 1] f32; [memT [B, L] f32 when has_cat]; outputs:
-    out [F*B, S*3] i32 accumulated, lid_out [C] i32.
+    d > 1 replays SEVERAL consecutive levels in the one launch (the shallow
+    megapass): the leaf id chains through the per-level split tables
+    in-register, each level accumulating into its own [S*nch] column band —
+    one bins read and one launch for the whole shallow stack. The serial
+    hist -> best-split -> route dependency means all d tables must already
+    be known, so d > 1 is a replay (profiling / parity harnesses); d = 1 is
+    the live level pass.
+
+    refs: bins [F, C] u8; gq/hq/cq [C] i8; lid [C] i32; tabs [D*8, L] f32
+    (rows per level: feat, thr, dleft, new_leaf, slot_left, slot_right,
+    is_cat, _); nab [F, 1] f32; [memT [D*B, L] f32 when has_cat]; outputs:
+    out [F*B, D*S*nch] i32 accumulated, lid_out [C] i32.
     """
     if has_cat:
         (bins_ref, gq_ref, hq_ref, cq_ref, lid_ref, tabs_ref, nab_ref,
@@ -368,47 +393,12 @@ def _kernel_q8_fused(*refs, f: int, b: int, s: int, l: int, chunk: int,
     def _():
         out_ref[:] = jnp.zeros_like(out_ref)
 
-    # ---- route (see _route_kernel for the one-hot decode rationale) ----
-    lid = lid_ref[:].reshape(1, chunk)
-    iota_l = jax.lax.broadcasted_iota(jnp.int32, (l, chunk), 0)
-    oh = (lid == iota_l).astype(jnp.float32)                     # [L, C]
-    tv = jax.lax.dot_general(
-        tabs_ref[:], oh, dimension_numbers=(((1,), (0,)), ((), ())),
-        preferred_element_type=jnp.float32,
-        precision=jax.lax.Precision.HIGHEST)                     # [8, C]
-    feat, thr, dleft = tv[0:1], tv[1:2], tv[2:3]
-    new_leaf, slot_l, slot_r = tv[3:4], tv[4:5], tv[5:6]
-
     bins_i = bins_ref[:].astype(jnp.int32)                       # [F, C]
     bins_f = bins_i.astype(jnp.float32)
+    iota_l = jax.lax.broadcasted_iota(jnp.int32, (l, chunk), 0)
     iota_f = jax.lax.broadcasted_iota(jnp.int32, (f, chunk), 0) \
         .astype(jnp.float32)
-    fm = iota_f == feat
-    colv = jnp.sum(jnp.where(fm, bins_f, 0.0), axis=0, keepdims=True)
-    nav = jnp.sum(jnp.where(fm, nab_ref[:].astype(jnp.float32), 0.0),
-                  axis=0, keepdims=True)
-    has = jnp.where(feat >= 0, 1.0, 0.0)
-    is_na = jnp.where(colv == nav, 1.0, 0.0)
-    gr_na = jnp.where(dleft == 0, 1.0, 0.0)
-    gr_num = jnp.where(colv > thr, 1.0, 0.0)
-    go_right = is_na * gr_na + (1.0 - is_na) * gr_num
-    if has_cat:
-        mem_bc = jax.lax.dot_general(
-            memT_ref[:], oh, dimension_numbers=(((1,), (0,)), ((), ())),
-            preferred_element_type=jnp.float32)                  # [B, C]
-        iota_b1 = jax.lax.broadcasted_iota(jnp.int32, (b, chunk), 0) \
-            .astype(jnp.float32)
-        member = jnp.sum(jnp.where(iota_b1 == colv, mem_bc, 0.0),
-                         axis=0, keepdims=True)
-        iscat = tv[6:7]
-        go_right = iscat * (1.0 - member) + (1.0 - iscat) * go_right
-    lid2 = jnp.where(has * go_right > 0, new_leaf, lid)
-    slot_f = has * (go_right * slot_r + (1.0 - go_right) * slot_l) \
-        + (1.0 - has) * float(s)
-    lid_out[:] = lid2.astype(jnp.int32).reshape(chunk)
-    slot = jnp.minimum(slot_f.astype(jnp.int32), s)              # [1, C]
-
-    # ---- int8 histogram (see _kernel_q8 / _onehot_i8) ----
+    nab_f = nab_ref[:].astype(jnp.float32)
     onehot = _onehot_i8(bins_i, f, b, chunk, swar)
     g = gq_ref[:].reshape(1, chunk).astype(jnp.int32)
     c = cq_ref[:].reshape(1, chunk).astype(jnp.int32)
@@ -417,29 +407,92 @@ def _kernel_q8_fused(*refs, f: int, b: int, s: int, l: int, chunk: int,
         ghc = jnp.concatenate([g, h, c], axis=0)
     else:   # constant hessian: (gq, count) only
         ghc = jnp.concatenate([g, c], axis=0)
-    w = jax.lax.broadcast_in_dim(ghc, (s, nch, chunk), (1, 2)) \
+    wv = jax.lax.broadcast_in_dim(ghc, (s, nch, chunk), (1, 2)) \
         .reshape(s * nch, chunk)
     slot_of_row = jax.lax.broadcasted_iota(
         jnp.int32, (s * nch, chunk), 0) // nch
-    w = jnp.where(slot == slot_of_row, w, 0).astype(jnp.int8)
-    part = jax.lax.dot_general(
-        onehot, w, dimension_numbers=(((1,), (1,)), ((), ())),
-        preferred_element_type=jnp.int32)
-    out_ref[:] += part
+
+    lid = lid_ref[:].reshape(1, chunk)
+    for dd in range(d):
+        # ---- route (see _route_kernel for the one-hot decode rationale) ----
+        oh = (lid == iota_l).astype(jnp.float32)                 # [L, C]
+        tv = jax.lax.dot_general(
+            tabs_ref[dd * 8:(dd + 1) * 8, :], oh,
+            dimension_numbers=(((1,), (0,)), ((), ())),
+            preferred_element_type=jnp.float32,
+            precision=jax.lax.Precision.HIGHEST)                 # [8, C]
+        feat, thr, dleft = tv[0:1], tv[1:2], tv[2:3]
+        new_leaf, slot_l, slot_r = tv[3:4], tv[4:5], tv[5:6]
+        fm = iota_f == feat
+        colv = jnp.sum(jnp.where(fm, bins_f, 0.0), axis=0, keepdims=True)
+        nav = jnp.sum(jnp.where(fm, nab_f, 0.0), axis=0, keepdims=True)
+        has = jnp.where(feat >= 0, 1.0, 0.0)
+        is_na = jnp.where(colv == nav, 1.0, 0.0)
+        gr_na = jnp.where(dleft == 0, 1.0, 0.0)
+        gr_num = jnp.where(colv > thr, 1.0, 0.0)
+        go_right = is_na * gr_na + (1.0 - is_na) * gr_num
+        if has_cat:
+            mem_bc = jax.lax.dot_general(
+                memT_ref[dd * b:(dd + 1) * b, :], oh,
+                dimension_numbers=(((1,), (0,)), ((), ())),
+                preferred_element_type=jnp.float32)              # [B, C]
+            iota_b1 = jax.lax.broadcasted_iota(jnp.int32, (b, chunk), 0) \
+                .astype(jnp.float32)
+            member = jnp.sum(jnp.where(iota_b1 == colv, mem_bc, 0.0),
+                             axis=0, keepdims=True)
+            iscat = tv[6:7]
+            go_right = iscat * (1.0 - member) + (1.0 - iscat) * go_right
+        lid2 = jnp.where(has * go_right > 0, new_leaf, lid)
+        slot_f = has * (go_right * slot_r + (1.0 - go_right) * slot_l) \
+            + (1.0 - has) * float(s)
+        slot = jnp.minimum(slot_f.astype(jnp.int32), s)          # [1, C]
+
+        # ---- int8 histogram (see _kernel_q8 / _onehot_i8) ----
+        w = jnp.where(slot == slot_of_row, wv, 0).astype(jnp.int8)
+        part = jax.lax.dot_general(
+            onehot, w, dimension_numbers=(((1,), (1,)), ((), ())),
+            preferred_element_type=jnp.int32)
+        out_ref[:, dd * s * nch:(dd + 1) * s * nch] += part
+        lid = lid2.astype(jnp.int32)
+    lid_out[:] = lid.reshape(chunk)
 
 
-def hist_routed_fused_q8(bins_T, gq, hq, cq, leaf_id, tables, na_bin,
-                         num_slots: int, num_bins: int, scale_g, scale_h,
-                         num_leaves: int, chunk: int = 0,
-                         const_hess: bool = False,
-                         interpret: bool = False):
-    """Fused route+histogram level pass. Returns ([S, 3, F, B] f32, lid2 [N]).
+def _route_tabs(tables, l: int) -> jnp.ndarray:
+    """One level's RouteTables as the kernel's [8, L] f32 decode rows."""
+    iscat_row = (tables.is_cat.astype(jnp.float32)
+                 if tables.is_cat is not None
+                 else jnp.zeros(l, jnp.float32))
+    return jnp.stack([
+        tables.feat.astype(jnp.float32), tables.thr.astype(jnp.float32),
+        tables.dleft.astype(jnp.float32), tables.new_leaf.astype(jnp.float32),
+        tables.slot_left.astype(jnp.float32),
+        tables.slot_right.astype(jnp.float32),
+        iscat_row, jnp.zeros(l, jnp.float32)])                    # [8, L]
+
+
+def hist_routed_fused_multi_q8(bins_T, gq, hq, cq, leaf_id, tables_seq,
+                               na_bin, num_slots: int, num_bins: int,
+                               scale_g, scale_h, num_leaves: int,
+                               chunk: int = 0, const_hess: bool = False,
+                               interpret: bool = False):
+    """Multi-level fused route+histogram megapass.
+
+    ``tables_seq``: sequence of D per-level RouteTables. ONE kernel launch
+    routes every row through all D consecutive levels, accumulating each
+    level's slot histogram into its own column band. Returns
+    (hist [D, S, 3, F, B] f32, lid_final [N] i32), bit-identical to D
+    sequential hist_routed_fused_q8 calls (int32 accumulation is
+    order-independent; the routing arithmetic is the same ops in the same
+    order). D=1 is the live level pass; D>1 requires all D split tables up
+    front — a replay — because split selection at level d depends on the
+    reduced histogram of level d-1 (see PERF_NOTES Round 9).
 
     Only valid when every feature fits one accumulator block
     (F * num_bins <= _ACC_ROWS_MAX) — the router must see ALL columns.
     const_hess: see hist_pallas_q8."""
     f, n = bins_T.shape
     b, s, l = num_bins, num_slots, num_leaves
+    d = len(tables_seq)
     nch = 2 if const_hess else 3
     assert f * b <= _ACC_ROWS_MAX
     if chunk == 0:
@@ -447,19 +500,12 @@ def hist_routed_fused_q8(bins_T, gq, hq, cq, leaf_id, tables, na_bin,
         # one-hot keeps 4096 under the 16MB VMEM ceiling through S=127
         # (measured 35 -> 31.7 ms at S=127). Without SWAR (B > 128 or
         # interpret) the compare path's wider intermediates keep the old
-        # 192-row threshold
+        # 192-row threshold. The accumulator band is D levels wide.
         wide_ok = 384 if (_swar_ok(b, interpret) and f * b <= 1792) else 192
-        chunk = 4096 if s * nch <= wide_ok else 2048
+        chunk = 4096 if d * s * nch <= wide_ok else 2048
 
-    has_cat = tables.is_cat is not None
-    iscat_row = (tables.is_cat.astype(jnp.float32) if has_cat
-                 else jnp.zeros(l, jnp.float32))
-    tabs = jnp.stack([
-        tables.feat.astype(jnp.float32), tables.thr.astype(jnp.float32),
-        tables.dleft.astype(jnp.float32), tables.new_leaf.astype(jnp.float32),
-        tables.slot_left.astype(jnp.float32),
-        tables.slot_right.astype(jnp.float32),
-        iscat_row, jnp.zeros(l, jnp.float32)])                    # [8, L]
+    has_cat = any(t.is_cat is not None for t in tables_seq)
+    tabs = jnp.concatenate([_route_tabs(t, l) for t in tables_seq], axis=0)
     nab = na_bin.astype(jnp.float32).reshape(f, 1)
 
     bins_Tp = _pad_rows(bins_T, chunk)
@@ -475,50 +521,73 @@ def hist_routed_fused_q8(bins_T, gq, hq, cq, leaf_id, tables, na_bin,
         pl.BlockSpec((chunk,), lambda i: (i,), memory_space=pltpu.VMEM),
         pl.BlockSpec((chunk,), lambda i: (i,), memory_space=pltpu.VMEM),
         pl.BlockSpec((chunk,), lambda i: (i,), memory_space=pltpu.VMEM),
-        pl.BlockSpec((8, l), lambda i: (0, 0), memory_space=pltpu.VMEM),
+        pl.BlockSpec((d * 8, l), lambda i: (0, 0), memory_space=pltpu.VMEM),
         pl.BlockSpec((f, 1), lambda i: (0, 0), memory_space=pltpu.VMEM),
     ]
     args = [bins_Tp, gq, hq, cq, lid_p, tabs, nab]
-    b_mem = tables.member.shape[1] if has_cat else 1
     if has_cat:
-        in_specs.append(pl.BlockSpec((b_mem, l), lambda i: (0, 0),
+        b_mem = next(t.member.shape[1] for t in tables_seq
+                     if t.member is not None)
+
+        def _memT(t):
+            if t.member is None:
+                return jnp.zeros((b_mem, l), jnp.float32)
+            return t.member.astype(jnp.float32).T
+        in_specs.append(pl.BlockSpec((d * b_mem, l), lambda i: (0, 0),
                                      memory_space=pltpu.VMEM))
-        args.append(tables.member.astype(jnp.float32).T)
+        args.append(jnp.concatenate([_memT(t) for t in tables_seq], axis=0))
 
     kern = functools.partial(_kernel_q8_fused, f=f, b=b, s=s, l=l,
                              chunk=chunk, has_cat=has_cat, nch=nch,
-                             swar=_swar_ok(b, interpret))
+                             swar=_swar_ok(b, interpret), d=d)
     out, lid2 = pl.pallas_call(
         kern,
         grid=(n_chunks,),
         in_specs=in_specs,
         out_specs=(
-            pl.BlockSpec((f * b, s * nch), lambda i: (0, 0),
+            pl.BlockSpec((f * b, d * s * nch), lambda i: (0, 0),
                          memory_space=pltpu.VMEM),
             pl.BlockSpec((chunk,), lambda i: (i,), memory_space=pltpu.VMEM),
         ),
         out_shape=(
-            jax.ShapeDtypeStruct((f * b, s * nch), jnp.int32),
+            jax.ShapeDtypeStruct((f * b, d * s * nch), jnp.int32),
             jax.ShapeDtypeStruct((bins_Tp.shape[1],), jnp.int32),
         ),
         cost_estimate=pl.CostEstimate(
-            flops=2 * n * f * b * s * nch + 2 * n * l * 9,
-            bytes_accessed=n * (f + 11) + f * b * s * 4 * nch,
+            flops=d * (2 * n * f * b * s * nch + 2 * n * l * 9),
+            bytes_accessed=n * (f + 11) + d * f * b * s * 4 * nch,
             transcendentals=0),
         interpret=interpret,
     )(*args)
 
-    out = out.reshape(f, b, s, nch).astype(jnp.float32)
+    out = out.reshape(f, b, d, s, nch).astype(jnp.float32)
     sg = scale_g * jnp.float32(1.0 / 127.0)
     sh = scale_h * jnp.float32(1.0 / 127.0)
     if const_hess:
         cnt = out[..., 1]
         hist = jnp.stack([out[..., 0] * sg, cnt * sh, cnt],
-                         axis=-1).transpose(2, 3, 0, 1)
+                         axis=-1).transpose(2, 3, 4, 0, 1)
     else:
         hist = jnp.stack([out[..., 0] * sg, out[..., 1] * sh, out[..., 2]],
-                         axis=-1).transpose(2, 3, 0, 1)
+                         axis=-1).transpose(2, 3, 4, 0, 1)
     return hist, lid2[:n]
+
+
+def hist_routed_fused_q8(bins_T, gq, hq, cq, leaf_id, tables, na_bin,
+                         num_slots: int, num_bins: int, scale_g, scale_h,
+                         num_leaves: int, chunk: int = 0,
+                         const_hess: bool = False,
+                         interpret: bool = False):
+    """Fused route+histogram level pass. Returns ([S, 3, F, B] f32, lid2 [N]).
+
+    The D=1 specialization of hist_routed_fused_multi_q8 — the live level
+    pass and the replay megapass share one traced program per shape, so
+    they cost a single lowering between them."""
+    hist, lid2 = hist_routed_fused_multi_q8(
+        bins_T, gq, hq, cq, leaf_id, (tables,), na_bin, num_slots, num_bins,
+        scale_g, scale_h, num_leaves, chunk=chunk, const_hess=const_hess,
+        interpret=interpret)
+    return hist[0], lid2
 
 
 def _leaf_sums_kernel(g_ref, h_ref, c_ref, lid_ref, out_ref, *,
@@ -573,6 +642,307 @@ def leaf_sums_pallas(g, h, c, leaf_id, num_leaves: int, chunk: int = 8192,
         out_shape=jax.ShapeDtypeStruct((5, l), jnp.float32),
         interpret=interpret,
     )(g, h, c, lid)
+    return jnp.stack([out[0] + out[3], out[1] + out[4], out[2]], axis=0)
+
+
+# ---------------------------------------------------------------------------
+# fused gradient + quantization front (tentpole (b))
+#
+# The per-iteration front of the quantized depthwise path used to cost four
+# separate full-N HBM round-trips before the first level pass: the objective
+# gradient/hessian write, two quantize_sr reads, and the root-histogram read.
+# The two kernels below compute g/h IN-REGISTER from (score, aux, bag) — aux
+# is the objective's per-row constant (label for L2, label_pos for logloss) —
+# so the gradient rows are never materialized: one kernel emits the int8
+# channels, the scales and the root histogram; the other renews leaf sums at
+# tree end. Bit-identity with the unfused path is by construction: identical
+# f32 ops in identical order (jnp.exp included — the interpreter runs the
+# same XLA expf; compiled Mosaic exp can differ in the last ulp, which is
+# why the parity tests pin the CPU interpreter, see PERF_NOTES Round 9).
+# ---------------------------------------------------------------------------
+
+def _i32c(v: int) -> jnp.ndarray:
+    """uint32 constant as its two's-complement int32 bit pattern."""
+    v &= 0xFFFFFFFF
+    return jnp.int32(v - (1 << 32) if v >= (1 << 31) else v)
+
+
+def _lsr(x, k: int):
+    return jax.lax.shift_right_logical(x, jax.lax.full_like(x, k))
+
+
+def _sr_dither(idx, seed, salt: int):
+    """quantize_sr's counter-hash dither (ops/histogram.py) in int32 —
+    Mosaic has no uint32 vectors, but wrapping two's-complement add/mul is
+    bit-equal to uint32 arithmetic mod 2^32 and the shifts are explicitly
+    logical, so u matches the XLA uint32 version bit-for-bit."""
+    i = idx + _i32c(salt * 0x632BE59B)
+    z = (i ^ (seed * _i32c(0x9E3779B9))) * _i32c(2654435761)
+    z = (z ^ _lsr(z, 15)) * _i32c(2246822519)
+    z = z ^ _lsr(z, 13)
+    return _lsr(z, 8).astype(jnp.float32) * jnp.float32(1.0 / (1 << 24))
+
+
+def _grad_rows(spec, score, aux):
+    """In-register replica of the built-in objectives' get_gradients for the
+    fused front (see objectives.py fused_grad_spec). ``spec`` is static:
+    ("l2",) for unweighted RegressionL2 (grad = score - label, hess = 1) or
+    ("logloss", sigmoid, lw_pos, lw_neg) for unweighted Binary. Ops and
+    association order match the objective code exactly so the f32 results
+    are bit-identical."""
+    kind = spec[0]
+    if kind == "l2":
+        return score - aux, jnp.ones_like(score)
+    if kind == "logloss":
+        sigmoid, lw_pos, lw_neg = spec[1], spec[2], spec[3]
+        t = 2.0 * aux - 1.0
+        lw = jnp.where(aux > 0, lw_pos, lw_neg)
+        resp = 1.0 / (1.0 + jnp.exp(t * sigmoid * score))
+        grad = -t * resp * sigmoid * lw
+        hess = sigmoid * sigmoid * resp * (1.0 - resp) * lw
+        return grad, hess
+    raise ValueError(f"unsupported fused gradient spec: {spec!r}")
+
+
+def _grad_quant_kernel(bins_ref, score_ref, aux_ref, bag_ref, seed_ref,
+                       gq_ref, hq_ref, cq_ref, sc_ref, out_ref, mx_ref, *,
+                       f: int, b: int, chunk: int, spec,
+                       const_hess: bool, swar: bool):
+    """Two-phase fused gradient + SR-quantization + root histogram.
+
+    grid (2, n_chunks) — the TPU grid runs the trailing axis innermost, so
+    every phase-0 step (global max|g| / max h reduction into the mx scratch)
+    completes before the first phase-1 step reads the final scales. Each
+    phase recomputes g/h in-register from (score, aux, bag): two reads of
+    three [N] f32 rows replace the unfused path's separate gradient
+    write + quantize reads + histogram read.
+
+    bins [F, C] u8; score/aux/bag [C] f32; seed (1, 1) i32 SMEM; outputs
+    gq/hq/cq [C] i8, sc (8, 128) f32 (row 0 lane 0 = scale_g, row 1 lane 0 =
+    scale_h), out [F*B, nch] i32; scratch mx (2, 128) f32 lane-max partials.
+    """
+    nch = 2 if const_hess else 3
+    p = pl.program_id(0)
+    i = pl.program_id(1)
+
+    @pl.when((p == 0) & (i == 0))
+    def _():
+        mx_ref[:] = jnp.zeros_like(mx_ref)
+        out_ref[:] = jnp.zeros_like(out_ref)
+        sc_ref[:] = jnp.zeros_like(sc_ref)
+
+    score = score_ref[:].reshape(1, chunk)
+    aux = aux_ref[:].reshape(1, chunk)
+    bag = bag_ref[:].reshape(1, chunk)
+    grad, hess = _grad_rows(spec, score, aux)
+    g = grad * bag
+    h = hess * bag
+
+    @pl.when(p == 0)
+    def _():
+        # lane-parallel partial max; channels are 0 on padded rows, so the
+        # zero init is neutral (|g| >= 0, and h >= 0 on both spec families)
+        pg = jnp.max(jnp.abs(g).reshape(chunk // 128, 128), axis=0,
+                     keepdims=True)
+        hv = h if const_hess else jnp.abs(h)
+        ph = jnp.max(hv.reshape(chunk // 128, 128), axis=0, keepdims=True)
+        mx_ref[:] = jnp.maximum(mx_ref[:], jnp.concatenate([pg, ph], axis=0))
+        # the row-blocks are flushed once per phase; phase 0's visit writes
+        # zeros, phase 1 overwrites with the real values
+        gq_ref[:] = jnp.zeros_like(gq_ref)
+        hq_ref[:] = jnp.zeros_like(hq_ref)
+        cq_ref[:] = jnp.zeros_like(cq_ref)
+
+    @pl.when(p == 1)
+    def _():
+        mg = jnp.max(mx_ref[0:1, :], axis=1, keepdims=True)        # (1, 1)
+        mh = jnp.max(mx_ref[1:2, :], axis=1, keepdims=True)
+        # exact make_quant / quantize_sr scale semantics (histogram.py):
+        # scale_g floored at 1e-20; const-hess scale_h = 127 * max(h)
+        # (reconstructs h_const * count at dequant), unfloored
+        scale_g = jnp.maximum(mg, jnp.float32(1e-20))
+        scale_h = (jnp.float32(127.0) * mh if const_hess
+                   else jnp.maximum(mh, jnp.float32(1e-20)))
+
+        @pl.when(i == 0)
+        def _():
+            r = jax.lax.broadcasted_iota(jnp.int32, (8, 128), 0)
+            sc_ref[:] = jnp.where(r == 0, scale_g, 0.0) \
+                + jnp.where(r == 1, scale_h, 0.0)
+
+        idx = jax.lax.broadcasted_iota(jnp.int32, (1, chunk), 1) + i * chunk
+        seed = seed_ref[0, 0]
+        ug = _sr_dither(idx, seed, 1)
+        gq = jnp.clip(jnp.floor(g * (127.0 / scale_g) + ug), -127, 127)
+        gq_ref[:] = gq.astype(jnp.int8).reshape(chunk)
+        cw = jnp.where(bag > 0, 1.0, 0.0)
+        cq_ref[:] = cw.astype(jnp.int8).reshape(chunk)
+        if const_hess:
+            hq_ref[:] = jnp.zeros_like(hq_ref)
+            w3 = jnp.concatenate([gq.astype(jnp.int32),
+                                  cw.astype(jnp.int32)], axis=0)
+        else:
+            uh = _sr_dither(idx, seed, 2)
+            hq = jnp.clip(jnp.floor(h * (127.0 / scale_h) + uh), -127, 127)
+            hq_ref[:] = hq.astype(jnp.int8).reshape(chunk)
+            w3 = jnp.concatenate([gq.astype(jnp.int32), hq.astype(jnp.int32),
+                                  cw.astype(jnp.int32)], axis=0)
+        bins_i = bins_ref[:].astype(jnp.int32)
+        onehot = _onehot_i8(bins_i, f, b, chunk, swar)
+        part = jax.lax.dot_general(
+            onehot, w3.astype(jnp.int8),
+            dimension_numbers=(((1,), (1,)), ((), ())),
+            preferred_element_type=jnp.int32)                     # [F*B, nch]
+        out_ref[:] += part
+
+
+def grad_quant_hist0_pallas(bins_T, score, aux, bag, seed, spec,
+                            num_bins: int, const_hess: bool = False,
+                            chunk: int = 0, interpret: bool = False):
+    """Fused objective gradient + int8 quantization + root histogram.
+
+    Returns (gq [N] i8, hq [N] i8 | None, cq [N] i8, scale_g f32 scalar,
+    scale_h f32 scalar, hist0 [3, F, B] f32) — bit-identical to the unfused
+    objective.get_gradients -> make_quant -> hist_leaf chain on the Pallas
+    path (f32 max is order-independent, the dither hash is replayed exactly,
+    and the int32 histogram accumulation is order-independent).
+
+    Only valid when every feature fits one accumulator block
+    (F * num_bins <= _ACC_ROWS_MAX)."""
+    f, n = bins_T.shape
+    b = num_bins
+    nch = 2 if const_hess else 3
+    assert f * b <= _ACC_ROWS_MAX
+    if chunk == 0:
+        chunk = 4096 if (_swar_ok(b, interpret) and f * b <= 1792) else 2048
+    bins_Tp = _pad_rows(bins_T, chunk)
+    score_p = _pad_rows(score, chunk)
+    aux_p = _pad_rows(aux, chunk)
+    bag_p = _pad_rows(bag, chunk)   # padded rows: bag 0 -> zero channels
+    n_chunks = bins_Tp.shape[1] // chunk
+    seed_arr = jnp.asarray(seed).astype(jnp.int32).reshape(1, 1)
+
+    kern = functools.partial(_grad_quant_kernel, f=f, b=b, chunk=chunk,
+                             spec=spec, const_hess=const_hess,
+                             swar=_swar_ok(b, interpret))
+    gq, hq, cq, sc, out = pl.pallas_call(
+        kern,
+        grid=(2, n_chunks),
+        in_specs=[
+            pl.BlockSpec((f, chunk), lambda p, i: (0, i),
+                         memory_space=pltpu.VMEM),
+            pl.BlockSpec((chunk,), lambda p, i: (i,),
+                         memory_space=pltpu.VMEM),
+            pl.BlockSpec((chunk,), lambda p, i: (i,),
+                         memory_space=pltpu.VMEM),
+            pl.BlockSpec((chunk,), lambda p, i: (i,),
+                         memory_space=pltpu.VMEM),
+            pl.BlockSpec((1, 1), lambda p, i: (0, 0),
+                         memory_space=pltpu.SMEM),
+        ],
+        out_specs=(
+            pl.BlockSpec((chunk,), lambda p, i: (i,),
+                         memory_space=pltpu.VMEM),
+            pl.BlockSpec((chunk,), lambda p, i: (i,),
+                         memory_space=pltpu.VMEM),
+            pl.BlockSpec((chunk,), lambda p, i: (i,),
+                         memory_space=pltpu.VMEM),
+            pl.BlockSpec((8, 128), lambda p, i: (0, 0),
+                         memory_space=pltpu.VMEM),
+            pl.BlockSpec((f * b, nch), lambda p, i: (0, 0),
+                         memory_space=pltpu.VMEM),
+        ),
+        out_shape=(
+            jax.ShapeDtypeStruct((bins_Tp.shape[1],), jnp.int8),
+            jax.ShapeDtypeStruct((bins_Tp.shape[1],), jnp.int8),
+            jax.ShapeDtypeStruct((bins_Tp.shape[1],), jnp.int8),
+            jax.ShapeDtypeStruct((8, 128), jnp.float32),
+            jax.ShapeDtypeStruct((f * b, nch), jnp.int32),
+        ),
+        scratch_shapes=[pltpu.VMEM((2, 128), jnp.float32)],
+        cost_estimate=pl.CostEstimate(
+            flops=2 * n * f * b * nch + 40 * n,
+            bytes_accessed=n * (f + 12) * 2 + 3 * n + f * b * nch * 4,
+            transcendentals=2 * n if spec[0] == "logloss" else 0),
+        interpret=interpret,
+    )(bins_Tp, score_p, aux_p, bag_p, seed_arr)
+
+    scale_g = sc[0, 0]
+    scale_h = sc[1, 0]
+    out = out.reshape(f, b, nch).astype(jnp.float32)
+    sg = scale_g * jnp.float32(1.0 / 127.0)
+    sh = scale_h * jnp.float32(1.0 / 127.0)
+    if const_hess:
+        cnt = out[..., 1]
+        hist0 = jnp.stack([out[..., 0] * sg, cnt * sh, cnt],
+                          axis=-1).transpose(2, 0, 1)
+    else:
+        hist0 = jnp.stack([out[..., 0] * sg, out[..., 1] * sh, out[..., 2]],
+                          axis=-1).transpose(2, 0, 1)
+    return (gq[:n], None if const_hess else hq[:n], cq[:n],
+            scale_g, scale_h, hist0)
+
+
+def _leaf_sums_grad_kernel(score_ref, aux_ref, bag_ref, lid_ref, out_ref, *,
+                           l: int, chunk: int, spec):
+    """_leaf_sums_kernel with g/h/c computed in-register from
+    (score, aux, bag) — the fused-objective path's leaf renewal reads no
+    materialized gradient rows."""
+    i = pl.program_id(0)
+
+    @pl.when(i == 0)
+    def _():
+        out_ref[:] = jnp.zeros_like(out_ref)
+
+    score = score_ref[:].reshape(1, chunk)
+    aux = aux_ref[:].reshape(1, chunk)
+    bag = bag_ref[:].reshape(1, chunk)
+    grad, hess = _grad_rows(spec, score, aux)
+    g = grad * bag
+    h = hess * bag
+    c = jnp.where(bag > 0, 1.0, 0.0)
+    gh = jnp.concatenate([g, h], axis=0)                         # [2, C] f32
+    hi = gh.astype(jnp.bfloat16)
+    lo = (gh - hi.astype(jnp.float32)).astype(jnp.bfloat16)
+    w = jnp.concatenate([hi, c.astype(jnp.bfloat16), lo], axis=0)  # [5, C]
+    lid = lid_ref[:].reshape(1, chunk)
+    iota_l = jax.lax.broadcasted_iota(jnp.int32, (l, chunk), 0)
+    oh = (lid == iota_l).astype(jnp.bfloat16)                    # [L, C]
+    part = jax.lax.dot_general(
+        w, oh, dimension_numbers=(((1,), (1,)), ((), ())),
+        preferred_element_type=jnp.float32)                      # [5, L]
+    out_ref[:] += part
+
+
+def leaf_sums_grad_pallas(score, aux, bag, leaf_id, spec, num_leaves: int,
+                          chunk: int = 8192,
+                          interpret: bool = False) -> jnp.ndarray:
+    """leaf_sums_pallas for the fused-objective path: [3, L] f32,
+    bit-identical to leaf_sums_pallas(g, h, c, ...) on the same rows (same
+    chunking, same hi/lo bf16 contraction; g/h/c recomputed in-register)."""
+    l = num_leaves
+    n = score.shape[0]
+    score = _pad_rows(score, chunk)
+    aux = _pad_rows(aux, chunk)
+    bag = _pad_rows(bag, chunk)
+    lid = _pad_rows(leaf_id, chunk, value=l)   # padded rows -> no leaf
+    n_chunks = score.shape[0] // chunk
+    kern = functools.partial(_leaf_sums_grad_kernel, l=l, chunk=chunk,
+                             spec=spec)
+    out = pl.pallas_call(
+        kern,
+        grid=(n_chunks,),
+        in_specs=[
+            pl.BlockSpec((chunk,), lambda i: (i,), memory_space=pltpu.VMEM),
+            pl.BlockSpec((chunk,), lambda i: (i,), memory_space=pltpu.VMEM),
+            pl.BlockSpec((chunk,), lambda i: (i,), memory_space=pltpu.VMEM),
+            pl.BlockSpec((chunk,), lambda i: (i,), memory_space=pltpu.VMEM),
+        ],
+        out_specs=pl.BlockSpec((5, l), lambda i: (0, 0),
+                               memory_space=pltpu.VMEM),
+        out_shape=jax.ShapeDtypeStruct((5, l), jnp.float32),
+        interpret=interpret,
+    )(score, aux, bag, lid)
     return jnp.stack([out[0] + out[3], out[1] + out[4], out[2]], axis=0)
 
 

@@ -14,13 +14,20 @@ import jax
 import jax.numpy as jnp
 
 
+@partial(jax.jit, static_argnames=("max_steps",))
 def route_bins(split_feature, threshold_bin, default_left, left_child, right_child,
                num_leaves, bins, na_bin, max_steps: int,
                is_cat=None, cat_mask=None):
     """Leaf index for each row of a *binned* matrix. bins: [N, F] uint8/int32.
 
     is_cat [n_nodes] bool + cat_mask [n_nodes, B] bool extend the walk with
-    categorical subset decisions (bin member -> LEFT; reference: tree.h:279)."""
+    categorical subset decisions (bin member -> LEFT; reference: tree.h:279).
+
+    Jitted with the tree arrays as traced ARGUMENTS: the eager form baked
+    them into the fori_loop body's jaxpr as constants, so every call with a
+    new tree lowered a fresh program (DART's per-iteration drop/re-add
+    walked 6+ lowerings per iteration). Inside an outer jit the wrapper
+    just inlines."""
     n = bins.shape[0]
     # pointer: >=0 internal node, <0 leaf (~leaf)
     start = jnp.where(num_leaves > 1, 0, -1)

@@ -23,13 +23,17 @@ structural spec of everything that shapes the traced program, falling back
 to plain jit dispatch on any mismatch. The join in ``adopt`` is the barrier
 before first dispatch the pipeline design calls for.
 
-Scope: the serial single-process tree learner with a built-in objective
-(plain gbdt boosting), INCLUDING its mesh-native row-sharded form (the
-lowering then runs against sharded avals — a dataset-published RowShardPlan
-fixes the padded shapes and the NamedSharding before ingest starts).
-Everything else — explicit data/voting/feature learners, GOSS's custom-grad
-step, dart's reweighting — skips the prewarm and compiles at first dispatch
-exactly as before. ``prewarm=0`` is the kill switch.
+Scope: the serial single-process tree learner with a built-in objective,
+for ALL FOUR boosters — gbdt and dart share the auto-gradient step program;
+goss and rf feed explicit gradients, so their prewarm lowers the
+custom-gradient step instead (``handle.result["custom"]`` records which one
+was built and ``adopt`` rejects a mismatch). The gbdt form includes the
+mesh-native row-sharded trainer (the lowering then runs against sharded
+avals — a dataset-published RowShardPlan fixes the padded shapes and the
+NamedSharding before ingest starts). Everything else — explicit
+data/voting/feature learners, multi-machine — skips the prewarm and
+compiles at first dispatch exactly as before. ``prewarm=0`` is the kill
+switch.
 """
 from __future__ import annotations
 
@@ -99,13 +103,20 @@ def step_spec(gbdt) -> Dict[str, Any]:
         # the plan's mesh); shard count 0 = unsharded
         "shards": (int(gbdt._plan.num_shards)
                    if getattr(gbdt, "_plan", None) is not None else 0),
+        # the fused grad+quant+hist0 front and the cached transposed bin
+        # matrix both change the traced program (and the argument avals);
+        # neither is fully derivable from the conf fields alone
+        "fused": gbdt._fused_front()[0],
+        "bt": gbdt._use_bt(),
         "conf": {k: getattr(conf, k, None) for k in _SPEC_KEYS},
     }
 
 
-def step_avals(gbdt):
+def step_avals(gbdt, custom: bool = False):
     """ShapeDtypeStructs matching GBDT._fused_step's argument construction
-    exactly (order and dtypes included).
+    exactly (order and dtypes included). ``custom=True`` mirrors the
+    explicit-gradient dispatch (GOSS/RF): grad/hess are score-shaped row
+    arrays instead of scalar dummies, and the fused front is off.
 
     With a mesh-native RowShardPlan the bins aval is [n_padded, f] and
     carries the plan's NamedSharding — lowering against the sharded aval is
@@ -134,27 +145,45 @@ def step_avals(gbdt):
                       sharding=plan.sharding(2))
     else:
         bins_aval = S((n, f), np.uint8)
+    gh = score if custom else sc_f      # explicit gradients are score-shaped
+    # the cached [F, N] transposed bin matrix rides along on serial Pallas
+    # trainers; the fused grad+quant+hist0 front adds the objective's aux
+    # rows (auto path only). Both fall back to the scalar dummy aval the
+    # dispatch passes when the corresponding gate is off.
+    bt = S((f, n), np.uint8) if gbdt._use_bt() else sc_f
+    fused_spec, fused_aux = (None, None) if custom else gbdt._fused_front()
+    if fused_spec is not None:
+        import jax as _jax
+        aux = _jax.tree_util.tree_map(lambda a: S(a.shape, a.dtype),
+                                      fused_aux)
+    else:
+        aux = sc_f
     return (bins_aval,                  # bins
             S((f,), np.int32),          # num_bins
             S((f,), np.int32),          # na_bin
             score,                      # train score
             S((f,), np.bool_),          # feature mask
             S((n,), np.float32),        # bag weights
-            sc_f, sc_f,                 # grad/hess dummies (auto path)
+            gh, gh,                     # grad/hess (dummies on auto path)
             sc_f,                       # shrink
             S((), np.int32),            # qseed
             sc_f,                       # titer
-            cegb)
+            cegb,                       # CEGB state (dummy when off)
+            bt,                         # transposed bins (dummy when off)
+            aux)                        # fused-front aux rows (dummy when off)
 
 
-def aot_compile_step(gbdt, fn=None, tag: str = "cold"):
-    """Lower + XLA-compile the auto fused step out of band. Returns
-    (jit wrapper, Compiled executable, seconds). ``tag`` labels the compile
-    event cold/warm so the bench can split the two without guessing."""
+def aot_compile_step(gbdt, fn=None, tag: str = "cold",
+                     custom: bool = False):
+    """Lower + XLA-compile the fused step out of band (auto-gradient by
+    default; ``custom=True`` builds the explicit-gradient step GOSS/RF
+    dispatch). Returns (jit wrapper, Compiled executable, seconds). ``tag``
+    labels the compile event cold/warm so the bench can split the two
+    without guessing."""
     if fn is None:
-        fn = gbdt._build_fused_step(custom=False)
+        fn = gbdt._build_fused_step(custom=custom)
     t0 = time.perf_counter()
-    compiled = fn.lower(*step_avals(gbdt)).compile()
+    compiled = fn.lower(*step_avals(gbdt, custom=custom)).compile()
     dt = time.perf_counter() - t0
     if obs.enabled():
         # cache_size 0: AOT compilation does not enter the wrapper's
@@ -177,8 +206,9 @@ def _skip_reason(conf, dataset) -> Optional[str]:
     n = int(dataset.num_data or 0)
     if n < MIN_PREWARM_ROWS:
         return f"num_data={n} < {MIN_PREWARM_ROWS} (nothing to hide behind)"
-    if conf.boosting not in ("gbdt", "gbrt"):
-        return f"boosting={conf.boosting} (custom-step variants recompile)"
+    if conf.boosting not in ("gbdt", "gbrt", "dart", "goss", "rf",
+                             "random_forest"):
+        return f"boosting={conf.boosting} (unknown booster)"
     if conf.tree_learner not in ("serial",):
         return f"tree_learner={conf.tree_learner} (sharded args differ)"
     if conf.num_machines > 1:
@@ -208,13 +238,22 @@ def maybe_start(conf, dataset) -> Optional[PrewarmHandle]:
             # compile-at-dispatch (adoption miss), never break training
             from .utils import faults
             faults.fault_point("prewarm_compile")
-            from .models.gbdt import GBDT
+            # lazy import: basic imports this module lazily from construct,
+            # so there is no cycle at import time
+            from .basic import booster_class
             from .objectives import create_objective
+            cls = booster_class(conf.boosting)
+            # GOSS (grad-dependent bagging) and RF (constant explicit
+            # gradients) dispatch the custom-gradient step; gbdt/dart the
+            # auto one. The flag travels with the handle so adopt() can
+            # refuse to hand a custom executable to an auto dispatch.
+            custom = bool(getattr(cls, "_needs_grad_for_bag", False)
+                          or getattr(cls, "average_output", False))
             objective = create_objective(conf.objective, conf)
-            g = GBDT(conf, dataset, objective, metrics=[], quiet=True)
+            g = cls(conf, dataset, objective, metrics=[], quiet=True)
             handle.spec = step_spec(g)
-            fn, compiled, _ = aot_compile_step(g, tag="cold")
-            handle.result.update(fn=fn, compiled=compiled,
+            fn, compiled, _ = aot_compile_step(g, tag="cold", custom=custom)
+            handle.result.update(fn=fn, compiled=compiled, custom=custom,
                                  duration_s=time.perf_counter() - t0)
             if tele:
                 obs.emit("aot_prewarm", phase="compiled",
@@ -234,10 +273,11 @@ def maybe_start(conf, dataset) -> Optional[PrewarmHandle]:
     return handle
 
 
-def adopt(handle: PrewarmHandle, gbdt):
+def adopt(handle: PrewarmHandle, gbdt, custom: bool = False):
     """Join the background compile (the before-first-dispatch barrier) and
     return its Compiled executable iff it was built for exactly this
-    trainer's step program; None means compile at dispatch as usual."""
+    trainer's step program AND the same custom/auto gradient flavour;
+    None means compile at dispatch as usual."""
     t0 = time.perf_counter()
     handle.join()
     wait = time.perf_counter() - t0
@@ -248,6 +288,14 @@ def adopt(handle: PrewarmHandle, gbdt):
             obs.emit("aot_prewarm", phase="miss",
                      reason=f"background compile failed: {str(err)[:160]}")
         log.debug("AOT prewarm unusable (%r); compiling at dispatch", err)
+        return None
+    if bool(handle.result.get("custom", False)) != bool(custom):
+        if tele:
+            obs.emit("aot_prewarm", phase="miss",
+                     reason="custom/auto step mismatch")
+        log.info("prewarmed step was compiled for the %s-gradient path; "
+                 "compiling at dispatch",
+                 "custom" if handle.result.get("custom") else "auto")
         return None
     if handle.spec != step_spec(gbdt):
         if tele:

@@ -1,28 +1,72 @@
-import sys, os
+"""Time the fused route+histogram q8 level pass across slot widths.
+
+``--json`` emits one machine-readable line (per-width ms + workload meta)
+instead of the human table; ``--rows`` shrinks the workload for CI smoke
+runs. Off-TPU the kernels run in pallas interpret mode, so the numbers are
+only meaningful on a real TPU backend — the JSON carries ``backend`` so a
+consumer can tell.
+"""
+import argparse
+import json
+import sys
+
 sys.path.insert(0, "/root/repo")
-import jax, jax.numpy as jnp, numpy as np
+
+import jax
+import jax.numpy as jnp
+import numpy as np
+
 from lightgbm_tpu.ops import histogram as H
 from lightgbm_tpu.ops import pallas_hist as PH
 from lightgbm_tpu.utils.timer import time_op_in_jit
 
-n, f, b, L = 10_000_000, 28, 64, 255
-rng = np.random.RandomState(0)
-bins_T = jnp.asarray(rng.randint(0, b, size=(f, n), dtype=np.uint8))
-gq = jnp.asarray(rng.randint(-127, 128, n, dtype=np.int8))
-hq = jnp.asarray(rng.randint(0, 128, n, dtype=np.int8))
-cq = jnp.ones(n, jnp.int8)
-lid = jnp.asarray(rng.randint(0, L, n, dtype=np.int32))
 
-for s in (1, 2, 8, 32, 64, 127):
-    tables = H.RouteTables(
-        feat=jnp.zeros(L, jnp.int32), thr=jnp.full(L, b // 2, jnp.int32),
-        dleft=jnp.zeros(L, jnp.int32), new_leaf=jnp.arange(L, dtype=jnp.int32),
-        slot_left=jnp.zeros(L, jnp.int32),
-        slot_right=jnp.minimum(jnp.ones(L, jnp.int32), s - 1))
-    ms = time_op_in_jit(
-        lambda i, bt, ll: PH.hist_routed_fused_q8(
-            bt, gq, hq, cq, jnp.minimum(ll + i, L - 1), tables,
-            jnp.full(f, b + 1, jnp.int32), s, b,
-            jnp.float32(1.0), jnp.float32(1.0), L)[0].sum(),
-        bins_T, lid, K=4, reps=2)
-    print(f"fused S={s:4d}: {ms:7.2f} ms")
+def main():
+    ap = argparse.ArgumentParser(description=__doc__)
+    ap.add_argument("--json", action="store_true",
+                    help="emit one JSON line instead of the human table")
+    ap.add_argument("--rows", type=int, default=10_000_000)
+    ap.add_argument("--features", type=int, default=28)
+    ap.add_argument("--max-bin", type=int, default=64)
+    ap.add_argument("--leaves", type=int, default=255)
+    ap.add_argument("--widths", type=int, nargs="*",
+                    default=(1, 2, 8, 32, 64, 127))
+    args = ap.parse_args()
+
+    n, f, b, L = args.rows, args.features, args.max_bin, args.leaves
+    interp = jax.default_backend() != "tpu"
+    rng = np.random.RandomState(0)
+    bins_T = jnp.asarray(rng.randint(0, b, size=(f, n), dtype=np.uint8))
+    gq = jnp.asarray(rng.randint(-127, 128, n, dtype=np.int8))
+    hq = jnp.asarray(rng.randint(0, 128, n, dtype=np.int8))
+    cq = jnp.ones(n, jnp.int8)
+    lid = jnp.asarray(rng.randint(0, L, n, dtype=np.int32))
+
+    results = []
+    for s in args.widths:
+        tables = H.RouteTables(
+            feat=jnp.zeros(L, jnp.int32), thr=jnp.full(L, b // 2, jnp.int32),
+            dleft=jnp.zeros(L, jnp.int32),
+            new_leaf=jnp.arange(L, dtype=jnp.int32),
+            slot_left=jnp.zeros(L, jnp.int32),
+            slot_right=jnp.minimum(jnp.ones(L, jnp.int32), s - 1))
+        ms = time_op_in_jit(
+            lambda i, bt, ll: PH.hist_routed_fused_q8(
+                bt, gq, hq, cq, jnp.minimum(ll + i, L - 1), tables,
+                jnp.full(f, b + 1, jnp.int32), s, b,
+                jnp.float32(1.0), jnp.float32(1.0), L,
+                interpret=interp)[0].sum(),
+            bins_T, lid, K=4, reps=2)
+        results.append({"slot_width": s, "ms": round(ms, 3)})
+        if not args.json:
+            print(f"fused S={s:4d}: {ms:7.2f} ms")
+    if args.json:
+        print(json.dumps({
+            "rows": n, "features": f, "max_bin": b, "num_leaves": L,
+            "backend": jax.default_backend(),
+            "master_slot_widths": list(PH.MASTER_SLOT_WIDTHS),
+            "fused_level_pass": results}))
+
+
+if __name__ == "__main__":
+    main()

@@ -76,10 +76,27 @@ def test_rule_reports_diff_without_probe(monkeypatch):
     assert "predict_warm_repeat" in findings[0].message
 
 
+PROBE_ENTRIES = {"dataset_construct", "train_3_iters", "predict_cold",
+                 "predict_warm_repeat", "train_3_iters_lossguide",
+                 "train_warm_extra2_dart", "train_warm_extra2_goss",
+                 "train_warm_extra2_rf", "predict_engine_warm"}
+
+
 def test_committed_budget_matches_probe_entry_names():
     committed = cb.load_budget()
-    assert set(committed) == {"dataset_construct", "train_3_iters",
-                              "predict_cold", "predict_warm_repeat"}
+    assert set(committed) == PROBE_ENTRIES
+
+
+def test_warmed_entries_budgeted_at_zero():
+    """The whole warmed surface — repeat predict, extra DART/GOSS/RF
+    iterations, pre-warmed serving buckets — must stay at exactly 0
+    lowerings; anything else is a per-call jit reaching a steady-state
+    path."""
+    committed = cb.load_budget()
+    for name in ("predict_warm_repeat", "train_warm_extra2_dart",
+                 "train_warm_extra2_goss", "train_warm_extra2_rf",
+                 "predict_engine_warm"):
+        assert committed.get(name) == 0, name
 
 
 # ---------------------------------------------------------------------------
@@ -124,6 +141,4 @@ def test_update_budget_cli_writes_current_counts(tmp_path, monkeypatch):
     monkeypatch.setattr(cb, "BUDGET_PATH", str(tmp_path / "budget.json"))
     assert cb.update_budget_cli() == 0
     written = cb.load_budget(str(tmp_path / "budget.json"))
-    assert written and set(written) == {"dataset_construct", "train_3_iters",
-                                        "predict_cold",
-                                        "predict_warm_repeat"}
+    assert written and set(written) == PROBE_ENTRIES

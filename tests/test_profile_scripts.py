@@ -1,0 +1,49 @@
+"""Smoke the profiling harnesses' ``--json`` surface: each script must run
+on the CPU backend (pallas interpret mode) at a tiny workload and emit one
+parseable JSON line with the fields the perf tooling consumes — including
+profile_level's shallow-level launch accounting (levels 0..D in exactly two
+pallas launches, megapass bit-identical to the sequential level passes)."""
+import json
+import os
+import subprocess
+import sys
+
+import pytest
+
+REPO_ROOT = os.path.dirname(os.path.dirname(os.path.abspath(__file__)))
+
+
+def _run_json(script, *args):
+    env = dict(os.environ, JAX_PLATFORMS="cpu")
+    proc = subprocess.run(
+        [sys.executable, os.path.join(REPO_ROOT, "scripts", script), "--json",
+         *args],
+        cwd=REPO_ROOT, env=env, capture_output=True, text=True, timeout=540)
+    assert proc.returncode == 0, proc.stderr[-2000:]
+    return json.loads(proc.stdout.strip().splitlines()[-1])
+
+
+@pytest.mark.slow
+def test_profile_fused_json():
+    doc = _run_json("profile_fused.py", "--rows", "512", "--widths", "1", "8")
+    assert doc["backend"] == "cpu"
+    assert doc["master_slot_widths"] == [32, 128, 512]
+    widths = [e["slot_width"] for e in doc["fused_level_pass"]]
+    assert widths == [1, 8]
+    assert all(e["ms"] > 0 for e in doc["fused_level_pass"])
+
+
+@pytest.mark.slow
+def test_profile_level_json_shallow_two_launches():
+    doc = _run_json("profile_level.py", "--rows", "512", "--leaves", "31",
+                    "--features", "4", "--max-bin", "16")
+    assert set(doc["phases_ms"]) == {"level_complete", "hist_routed",
+                                     "bookkeeping", "grow_tree_depthwise"}
+    shallow = doc["shallow"]
+    # the headline: levels 0..5 of one tree in exactly TWO pallas launches
+    # (grad+quant+hist0 front + one multi-level replay megapass), and the
+    # megapass must be bit-identical to running the levels one by one
+    assert shallow["pallas_launches"] == 2
+    assert len(shallow["launch_breakdown"]) == 2
+    assert shallow["bit_identical_vs_sequential"] is True
+    assert shallow["levels"] == [0, 1, 2, 3, 4, 5]
