@@ -313,6 +313,29 @@ def main():
                 **({"vs_ref_cli": pdoc["vs_ref_cli"]}
                    if "vs_ref_cli" in pdoc else {}),
             }
+    # surface the pod-scaling headline (scripts/bench_pod.py): multi-process
+    # overhead at 1/2/4 simulated hosts + the voting-parallel collective win
+    pod_path = os.path.join(os.path.dirname(os.path.abspath(__file__)),
+                            "MULTIHOST_BENCH.json")
+    if os.path.exists(pod_path):
+        with open(pod_path) as fh:
+            mdoc = json.load(fh)
+        entries = mdoc.get("entries", [])
+        vote64 = next((r for r in
+                       mdoc.get("collective_bytes_per_level", [])
+                       if r.get("num_features") == 64), None)
+        if entries:
+            worst = min(entries, key=lambda e: e["scaling_efficiency"])
+            result["multihost_bench"] = {
+                "backend": mdoc.get("backend"),
+                "hosts_swept": [e["num_hosts"] for e in entries],
+                "iters_per_sec_1host": entries[0]["iters_per_sec"],
+                "worst_scaling_efficiency": worst["scaling_efficiency"],
+                "all_tree_hashes_equal": mdoc.get("all_tree_hashes_equal"),
+                **({"voting_vs_full_bytes_f64":
+                    round(vote64["voting_bytes"] / vote64["full_bytes"], 4)}
+                   if vote64 else {}),
+            }
     # surface the 500-iteration parity headline (scripts/parity_bench.py)
     if par.get("tpu_valid_auc"):
         result["parity_500iter"] = {

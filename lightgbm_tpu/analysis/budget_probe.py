@@ -23,6 +23,12 @@ surface:
   averaging custom step must all reuse their warmed wrappers;
 - ``predict_engine_warm``: serving predicts at row counts whose buckets
   ``PredictEngine.warmup`` pre-compiled, budgeted at 0.
+
+``--multihost`` runs the pod-surface probe instead: the 2-D
+``("data","feature")`` mesh and voting-parallel step programs on a
+4-virtual-device backend. It is a separate invocation because
+``--xla_force_host_platform_device_count`` must be set before jax imports;
+the compile-budget rule launches both and merges the counts.
 """
 from __future__ import annotations
 
@@ -108,8 +114,66 @@ def measure() -> dict:
     return counts
 
 
+def measure_multihost() -> dict:
+    """Pod-surface lowerings: the 2-D ("data","feature") sliced-histogram
+    step and the voting-parallel top-k election step, on 4 virtual CPU
+    devices. Runs in its own probe process: the device-count flag only
+    takes effect if exported before jax ever imports."""
+    os.environ["JAX_PLATFORMS"] = "cpu"
+    os.environ["XLA_FLAGS"] = ("--xla_force_host_platform_device_count=4 "
+                               + os.environ.get("XLA_FLAGS", "")).strip()
+    os.environ.pop("LGBMTPU_LINT_ONLY", None)
+
+    import numpy as np
+    import jax
+    import jax._src.test_util as jtu
+
+    import lightgbm_tpu as lgb
+
+    rng = np.random.RandomState(0)
+    X = rng.rand(512, 16).astype(np.float32)
+    y = (rng.rand(512) > 0.5).astype(np.float32)
+    base = {"objective": "binary", "num_leaves": 7, "max_bin": 31,
+            "min_data_in_leaf": 5, "verbosity": -1, "prewarm": 0}
+
+    counts = {}
+    # same backend bring-up warmer as the plain probe
+    # one-shot by construction (runs once per probe process)
+    jax.jit(lambda a: a + 1)(np.float32(0)).block_until_ready()  # tpu-lint: disable=retrace-hazard
+
+    # 2-D mesh: per-level histogram = sliced psum over "data" + tiled
+    # all_gather over "feature" — a different step program than 1-D
+    params2d = {**base, "num_shards": 2, "feature_shards": 2}
+    ds2d = lgb.Dataset(X, label=y, params=params2d)
+    ds2d.construct()
+    with jtu.count_jit_and_pmap_lowerings() as n:
+        bst2d = lgb.train(params2d, ds2d, num_boost_round=3)
+    counts["train_3_iters_pod2d"] = int(n[0])
+    with jtu.count_jit_and_pmap_lowerings() as n:
+        bst2d.update()
+        bst2d.update()
+    counts["train_warm_extra2_pod2d"] = int(n[0])
+
+    # voting-parallel: local top-k election + elected-column psum
+    paramsv = {**base, "num_shards": 4, "voting_parallel": 1, "top_k": 3}
+    dsv = lgb.Dataset(X, label=y, params=paramsv)
+    dsv.construct()
+    with jtu.count_jit_and_pmap_lowerings() as n:
+        bstv = lgb.train(paramsv, dsv, num_boost_round=3)
+    counts["train_3_iters_voting"] = int(n[0])
+    with jtu.count_jit_and_pmap_lowerings() as n:
+        bstv.update()
+        bstv.update()
+    counts["train_warm_extra2_voting"] = int(n[0])
+
+    return counts
+
+
 def main() -> int:
-    counts = measure()
+    if "--multihost" in sys.argv[1:]:
+        counts = measure_multihost()
+    else:
+        counts = measure()
     json.dump({"counts": counts}, sys.stdout)
     sys.stdout.write("\n")
     return 0

@@ -404,10 +404,16 @@ def _receiver_of(base: ast.AST) -> str:
 
 def _axis_literal(call: ast.Call) -> Optional[str]:
     """The ``axis_name`` argument of a collective call, when it is a string
-    literal (positional or keyword); None for variables/expressions."""
+    literal (positional or keyword); None for variables/expressions.
+
+    ``axis_name`` always names the mesh axis; a bare ``axis`` keyword does
+    too EXCEPT on ``all_gather``, whose signature also has a positional
+    ``axis`` (the gather DIMENSION, an int) — there the name is the second
+    positional or the ``axis_name`` keyword."""
     cand: Optional[ast.AST] = None
     for kw in call.keywords:
-        if kw.arg in ("axis_name", "axis"):
+        if kw.arg == "axis_name" or \
+                (kw.arg == "axis" and call.func.attr != "all_gather"):
             cand = kw.value
     if cand is None:
         pos = 0 if call.func.attr == "axis_index" else 1

@@ -81,23 +81,31 @@ def diff_counts(measured: Dict[str, int],
 
 
 def measure(timeout_s: int = PROBE_TIMEOUT_S) -> Dict[str, int]:
-    """Run the probe in a fresh, canonical subprocess (single CPU device,
-    no inherited lint/telemetry env) and return its counts. Raises
-    RuntimeError with the probe's stderr tail on failure."""
+    """Run the probe in fresh, canonical subprocesses (no inherited
+    lint/telemetry env) and return the merged counts: one single-device
+    pass for the classic entries, one ``--multihost`` pass for the
+    pod-surface entries (that one sets its own 4-virtual-device XLA flag
+    before importing jax). Raises RuntimeError with the probe's stderr
+    tail on failure."""
     env = dict(os.environ)
     for k in ("LGBMTPU_LINT_ONLY", "LGBMTPU_TELEMETRY", "XLA_FLAGS"):
         env.pop(k, None)
     env["JAX_PLATFORMS"] = "cpu"
-    proc = subprocess.run(
-        [sys.executable, "-m", "lightgbm_tpu.analysis.budget_probe"],
-        cwd=REPO_ROOT, env=env, capture_output=True, text=True,
-        timeout=timeout_s)
-    if proc.returncode != 0:
-        tail = (proc.stderr or proc.stdout or "")[-2000:]
-        raise RuntimeError(f"budget probe failed (rc={proc.returncode}): "
-                           f"{tail}")
-    doc = json.loads(proc.stdout.strip().splitlines()[-1])
-    return {k: int(v) for k, v in doc["counts"].items()}
+    counts: Dict[str, int] = {}
+    for extra in ((), ("--multihost",)):
+        proc = subprocess.run(
+            [sys.executable, "-m", "lightgbm_tpu.analysis.budget_probe",
+             *extra],
+            cwd=REPO_ROOT, env=env, capture_output=True, text=True,
+            timeout=timeout_s)
+        if proc.returncode != 0:
+            tail = (proc.stderr or proc.stdout or "")[-2000:]
+            raise RuntimeError(
+                f"budget probe {' '.join(extra) or '(plain)'} failed "
+                f"(rc={proc.returncode}): {tail}")
+        doc = json.loads(proc.stdout.strip().splitlines()[-1])
+        counts.update({k: int(v) for k, v in doc["counts"].items()})
+    return counts
 
 
 def write_budget(measured: Dict[str, int],
@@ -107,8 +115,10 @@ def write_budget(measured: Dict[str, int],
         "version": 1,
         "comment": "Distinct jit lowerings per warmed entry point, measured "
                    "by lightgbm_tpu/analysis/budget_probe.py on a "
-                   "single-device CPU backend. Growth fails tpu-lint's "
-                   "compile-budget rule; regenerate deliberately with "
+                   "single-device CPU backend (pod2d/voting entries: a "
+                   "second --multihost pass on 4 virtual devices). Growth "
+                   "fails tpu-lint's compile-budget rule; regenerate "
+                   "deliberately with "
                    "`python -m lightgbm_tpu.analysis --update-budget`.",
         "entries": {k: int(v) for k, v in sorted(measured.items())},
     }

@@ -44,7 +44,11 @@ _SCOPE_FILES = ("lightgbm_tpu/serving.py", "lightgbm_tpu/server.py",
                 "lightgbm_tpu/wal.py",
                 # the delayed-label join buffer is mutated by serve-ingress
                 # capture, label-arrival handlers, and the sweep thread
-                "lightgbm_tpu/join.py")
+                "lightgbm_tpu/join.py",
+                # pod collectives run while the ingest worker threads are
+                # still committing chunks; any module-level state here is
+                # cross-thread by construction
+                "lightgbm_tpu/parallel/multihost.py")
 _SCOPE_DIRS = ("lightgbm_tpu/obs/", "lightgbm_tpu/fleet/")
 _MUTATING_METHODS = {"append", "extend", "add", "update", "setdefault",
                      "pop", "popitem", "clear", "remove", "insert",

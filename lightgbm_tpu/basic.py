@@ -296,13 +296,24 @@ class Dataset:
             from .parallel.mesh import init_distributed
             init_distributed(conf)
             distributed = jax.process_count() > 1
+        row0 = 0
+        n_local = int(raw.shape[0])
+        n_rows = n_local
         if distributed:
-            # distributed bin finding: feature slices per rank + mapper
-            # allgather — identical mappers on every rank by construction
-            # (dataset_loader.cpp:957-1040)
-            from .parallel.dist_data import find_bin_mappers_distributed
-            mappers = find_bin_mappers_distributed(
-                raw, retries=conf.network_retries, **bin_kw)
+            # pod-scale construct: every host holds ONLY its contiguous row
+            # block. Global bins come from merged per-host sketches so every
+            # host derives byte-identical mappers — identical to single-host
+            # find_bin_mappers over the concatenated rows, not merely
+            # identical across ranks (parallel/multihost.py docstring)
+            from .parallel import multihost
+            counts = multihost.allgather_rows(
+                np.array([n_local], np.int64), jax.process_count(),
+                jax.process_index(), retries=conf.network_retries,
+                name="row-count allgather").reshape(-1)
+            n_rows = int(counts.sum())
+            row0 = int(counts[: jax.process_index()].sum())
+            mappers = multihost.find_bin_mappers_pod(
+                raw, n_rows, row0, retries=conf.network_retries, **bin_kw)
         else:
             mappers = find_bin_mappers(raw, **bin_kw)
         _mark("find_bins_s")
@@ -311,44 +322,93 @@ class Dataset:
         # bit-identical to planning post-encode — which makes the FULL
         # dataset metadata (widths, bin counts, padded shapes) known before
         # a single bulk chunk is encoded
-        n_rows = raw.shape[0]
         rng = np.random.RandomState(conf.data_random_seed)
         sample_idx = (None if n_rows <= self._EFB_PLAN_SAMPLE
                       else rng.choice(n_rows, self._EFB_PLAN_SAMPLE,
                                       replace=False))
+        plan_sample_cnt = None
+        if distributed:
+            # the SAME global draw on every host, filtered to the local row
+            # block — summing the per-rank conflict counts (reduce_fn below)
+            # then reproduces the single-host plan sample exactly
+            plan_sample_cnt = (n_rows if sample_idx is None
+                              else int(len(sample_idx)))
+            if sample_idx is not None:
+                m = (sample_idx >= row0) & (sample_idx < row0 + n_local)
+                sample_idx = sample_idx[m] - row0
         sample = bin_data(raw if sample_idx is None else raw[sample_idx],
                           mappers)
         self.mappers = sample.mappers
         self.feature_map = sample.feature_map
         self.bundle_meta = self._plan_efb(conf, sample.bins, sample.mappers,
                                           sample.feature_map, distributed,
-                                          presampled=True)
+                                          presampled=True,
+                                          plan_sample_cnt=plan_sample_cnt)
         sample.bins = None   # host sample no longer needed
         _mark("efb_plan_s")
         self._derive_names(columns, raw.shape[1])
         num_bins, na_bin, mtypes, maxb = self._derive_meta()
-        self._publish_meta(num_bins, na_bin, mtypes, maxb)
         # mesh-native row sharding: the plan (pure metadata) is published
         # BEFORE ingest so chunk routing, the background prewarm's sharded
-        # avals and the trainer's shard_map all agree on one shard grid
-        from .parallel.mesh import plan_row_sharding, resolve_num_shards
+        # avals and the trainer's shard_map all agree on one shard grid.
+        # Derived before _publish_meta so pod mode can replicate the label
+        # over the plan's global mesh.
+        from .parallel.mesh import (plan_row_sharding,
+                                    resolve_feature_shards,
+                                    resolve_num_shards)
+        ns = resolve_num_shards(conf.num_shards)
+        fs_req = int(getattr(conf, "feature_shards", 0) or 0)
+        if distributed and int(conf.num_shards or 0) <= 0:
+            # pod auto: one row shard per device (feature axis carved out
+            # first when a 2-D mesh is requested) — auto single-shard would
+            # leave the other hosts' devices outside the mesh entirely
+            ns = max(1, jax.device_count() // max(1, fs_req))
+        fs = resolve_feature_shards(fs_req, int(len(num_bins)), ns)
         self.shard_plan = plan_row_sharding(
-            n_rows, resolve_num_shards(conf.num_shards),
-            axis_name=conf.mesh_axis)
+            n_rows, ns, axis_name=conf.mesh_axis, feature_shards=fs)
         if self.shard_plan is not None:
             log.info(f"row-sharded ingest: {self.shard_plan.num_shards} "
                      f"shards x {self.shard_plan.rows_per_shard} rows "
-                     f"(pad {self.shard_plan.pad_rows})")
+                     f"(pad {self.shard_plan.pad_rows}, "
+                     f"feature_shards {self.shard_plan.feature_shards})")
+        if distributed:
+            if self.shard_plan is None:
+                log.fatal("multi-host construct requires a row-shard plan; "
+                          "set num_shards > 1 (or leave it 0 for auto)")
+            multihost.verify_pod_plan(self.shard_plan)
+            plo, phi = multihost.host_row_range(self.shard_plan)
+            if (plo, phi) != (row0, row0 + n_local):
+                log.fatal(
+                    f"multi-host row split mismatch: this host holds global "
+                    f"rows [{row0}, {row0 + n_local}) but the shard plan "
+                    f"assigns [{plo}, {phi}); load each host's slice with "
+                    f"parallel.multihost.host_row_range/load_file_shard")
+            # host-side bookkeeping (objective init, boost_from_average,
+            # metrics) needs the GLOBAL label/weight/init_score vectors —
+            # tiny next to the feature matrix, which never leaves its shards
+            for attr in ("label", "weight", "init_score"):
+                v = getattr(self, attr)
+                if v is not None:
+                    setattr(self, attr, multihost.allgather_rows(
+                        np.asarray(v, np.float32), n_rows, row0,
+                        retries=conf.network_retries,
+                        name=f"{attr} allgather"))
+        self._publish_meta(num_bins, na_bin, mtypes, maxb)
         # shapes are now final: compile the fused train step in the
         # background while the pipeline below encodes/uploads the bulk rows
+        # (skipped in pod mode: every host must reach the collective compile
+        # in the SAME order, and a background race against the first step
+        # dispatch would be rank-dependent)
         from . import prewarm as _prewarm
-        self._prewarm = _prewarm.maybe_start(conf, self)
+        self._prewarm = None if distributed else _prewarm.maybe_start(
+            conf, self)
         from .ingest import stream_with_recovery
         bins_dev, plan_used, _rows_used = stream_with_recovery(
             raw, mappers, self.bundle_meta, width=int(len(num_bins)),
             chunk_rows=conf.ingest_chunk_rows,
             encode_threads=conf.encode_threads, phases=phases,
-            shard_plan=self.shard_plan, policy=conf.on_device_fault)
+            shard_plan=self.shard_plan, policy=conf.on_device_fault,
+            row0=row0)
         if plan_used is not self.shard_plan:
             # OOM-adaptive degradation changed the shard grid mid-ingest; the
             # published plan must match the matrix the trainer will adopt
@@ -395,7 +455,7 @@ class Dataset:
         return num_bins, na_bin, mtypes, maxb
 
     def _plan_efb(self, conf, sample_bins, mappers, feature_map, distributed,
-                  presampled):
+                  presampled, plan_sample_cnt=None):
         """EFB plan decision shared by both construct paths.
 
         ``presampled=True`` means ``sample_bins`` rows ARE the plan sample
@@ -433,7 +493,10 @@ class Dataset:
                     jnp.asarray(arr))).sum(axis=0)
         kw = {}
         if presampled:
-            kw["sample_cnt"] = max(int(sample_bins.shape[0]), 1)
+            # pod mode: the plan thresholds (conflict rates) divide by the
+            # GLOBAL sample size, not this host's slice of it
+            kw["sample_cnt"] = (int(plan_sample_cnt) if plan_sample_cnt
+                                else max(int(sample_bins.shape[0]), 1))
         return plan_bundles(sample_bins, mappers,
                             max_conflict_rate=conf.max_conflict_rate,
                             sparse_threshold=conf.sparse_threshold,
@@ -462,10 +525,21 @@ class Dataset:
         self.missing_type_dev = jax.device_put(self._mtypes_np)
         self.max_num_bins = int(maxb)
         self._num_features_used = int(len(self._num_bins_np))
-        if self.label is not None and not isinstance(self.label, jax.Array):
-            self.label = jax.device_put(np.asarray(self.label, np.float32))
-        if self.weight is not None and not isinstance(self.weight, jax.Array):
-            self.weight = jax.device_put(np.asarray(self.weight, np.float32))
+        from .parallel.multihost import plan_spans_processes, replicate_global
+        pod = plan_spans_processes(self.shard_plan)
+        for attr in ("label", "weight"):
+            v = getattr(self, attr)
+            if v is None or isinstance(v, jax.Array):
+                continue
+            if pod:
+                # single-device arrays cannot feed a computation over the
+                # global pod mesh; replicate (the vectors are tiny and every
+                # host holds the identical allgathered copy by construction)
+                setattr(self, attr, replicate_global(
+                    np.asarray(v, np.float32), self.shard_plan.mesh))
+            else:
+                setattr(self, attr,
+                        jax.device_put(np.asarray(v, np.float32)))
 
     def _finish_device(self, bins_np, num_bins_np, na_bin_np, mtypes_np, maxb):
         """Ship the binned dataset to device and mark construction done."""
@@ -1060,7 +1134,19 @@ class Booster:
         return self._gbdt.num_trees() if self._gbdt else len(self.trees)
 
     def raw_train_score(self):
-        return self._gbdt.train_score
+        score = self._gbdt.train_score
+        try:
+            fully = score.sharding.is_fully_addressable
+        except Exception:
+            fully = True
+        if fully or getattr(score, "is_fully_replicated", False):
+            return score
+        # pod: the step leaves train_score row-sharded across processes;
+        # user-facing fobj/eval code expects a host-fetchable full vector
+        from .models.gbdt import _host_gather
+        full = _host_gather(score)
+        n = self._gbdt.train_set.num_data
+        return full[:n] if full.shape[0] != n else full
 
     def eval_train(self):
         return self._gbdt.eval_train()

@@ -131,6 +131,68 @@ class BinMapper:
         return m
 
     @staticmethod
+    def from_sketch(
+        sketch: "FeatureSketch",
+        max_bin: int,
+        min_data_in_bin: int = 3,
+        use_missing: bool = True,
+        zero_as_missing: bool = False,
+        forced_bounds: Optional[Sequence[float]] = None,
+    ) -> "BinMapper":
+        """Find bins from a (possibly merged) :class:`FeatureSketch`.
+
+        Mirrors :meth:`from_sample` exactly — ``from_sample(values)`` equals
+        ``from_sketch(sketch_feature(values))`` bit-for-bit, and merging
+        per-host sketches first changes nothing because the sketch is exact
+        (distinct values with multiplicities, not an approximation).
+        """
+        if sketch.bin_type == BIN_CATEGORICAL:
+            return BinMapper._categorical_from_weighted(
+                sketch.distinct, sketch.counts, max_bin, min_data_in_bin,
+                use_missing)
+        na_cnt = int(sketch.na_cnt)
+        zero_cnt = int(sketch.zero_cnt)
+        if zero_as_missing:
+            missing_type = MISSING_ZERO
+        elif use_missing and na_cnt > 0:
+            missing_type = MISSING_NAN
+        else:
+            missing_type = MISSING_NONE
+            zero_cnt += na_cnt
+            na_cnt = 0
+        distinct = np.asarray(sketch.distinct, dtype=np.float64)
+        counts = np.asarray(sketch.counts, dtype=np.int64)
+        n_avail = max_bin - (1 if missing_type == MISSING_NAN else 0)
+        bounds = BinMapper._find_weighted_bounds(
+            distinct, counts, zero_cnt, n_avail, min_data_in_bin,
+            forced_bounds=forced_bounds)
+        assert len(bounds) <= n_avail, \
+            f"bin finding produced {len(bounds)} bounds > budget {n_avail}"
+        num_bins = len(bounds)
+        if missing_type == MISSING_NAN:
+            bounds = np.append(bounds, np.nan)
+            num_bins += 1
+
+        m = BinMapper(
+            num_bins=num_bins,
+            bin_type=BIN_NUMERICAL,
+            missing_type=missing_type,
+            upper_bounds=bounds,
+        )
+        m.default_bin = m._value_to_bin_scalar(0.0)
+        m.is_trivial = (num_bins <= 1)
+        m.sparse_rate = zero_cnt / max(1, sketch.total_cnt)
+        m.most_freq_bin = m.default_bin if m.sparse_rate >= 0.5 else 0
+        if len(distinct) or zero_cnt:
+            lo = float(distinct[0]) if len(distinct) else 0.0
+            hi = float(distinct[-1]) if len(distinct) else 0.0
+            if zero_cnt:
+                lo, hi = min(lo, 0.0), max(hi, 0.0)
+            m.min_value = lo
+            m.max_value = hi
+        return m
+
+    @staticmethod
     def _find_numerical_bounds(
         nonzero: np.ndarray,
         zero_cnt: int,
@@ -144,7 +206,30 @@ class BinMapper:
         zeros exist (so zero gets its own bin and ``zero_as_missing`` semantics are
         representable); final bound is +inf.
         """
-        if len(nonzero) == 0 and zero_cnt == 0:
+        distinct, counts = np.unique(nonzero, return_counts=True)
+        return BinMapper._find_weighted_bounds(
+            distinct, counts.astype(np.int64), zero_cnt, max_bin,
+            min_data_in_bin, forced_bounds=forced_bounds)
+
+    @staticmethod
+    def _find_weighted_bounds(
+        distinct: np.ndarray,
+        counts: np.ndarray,
+        zero_cnt: int,
+        max_bin: int,
+        min_data_in_bin: int,
+        forced_bounds: Optional[Sequence[float]] = None,
+    ) -> np.ndarray:
+        """Weighted form of ``_find_numerical_bounds``: ``distinct`` are the
+        sorted unique nonzero values, ``counts`` their multiplicities.
+
+        Shared by the sampling path (which feeds it ``np.unique`` of the raw
+        sample) and the multi-host merged-sketch path
+        (``parallel/multihost.py``). Because the sampling path IS a
+        single-shard sketch, bounds from a merge of per-host sketches are
+        byte-identical to a single-host run over the concatenated sample.
+        """
+        if len(distinct) == 0 and zero_cnt == 0:
             return np.array([np.inf])
         if forced_bounds is not None and len(forced_bounds):
             # user-forced boundaries (reference: forcedbins_filename,
@@ -157,10 +242,9 @@ class BinMapper:
         # _fix_zero_boundary will add, so the final count never exceeds max_bin
         reserve = 0
         if zero_cnt > 0:
-            reserve = int(np.any(nonzero < -K_ZERO_THRESHOLD)) \
-                + int(np.any(nonzero > K_ZERO_THRESHOLD))
+            reserve = int(np.any(distinct < -K_ZERO_THRESHOLD)) \
+                + int(np.any(distinct > K_ZERO_THRESHOLD))
         budget = max(1, max_bin - reserve)
-        distinct, counts = np.unique(nonzero, return_counts=True)
         if zero_cnt > 0:
             pos = np.searchsorted(distinct, 0.0)
             distinct = np.insert(distinct, pos, 0.0)
@@ -248,6 +332,23 @@ class BinMapper:
         if implicit_zeros:
             cats = np.concatenate([cats, np.zeros(implicit_zeros, dtype=np.int64)])
         distinct, counts = np.unique(cats, return_counts=True)
+        return BinMapper._categorical_from_weighted(
+            distinct, counts.astype(np.int64), max_bin, min_data_in_bin,
+            use_missing)
+
+    @staticmethod
+    def _categorical_from_weighted(
+        distinct: np.ndarray, counts: np.ndarray, max_bin: int,
+        min_data_in_bin: int, use_missing: bool,
+    ) -> "BinMapper":
+        """Weighted form of ``_categorical_from_sample`` over (sorted distinct
+        categories, multiplicities) — shared with the merged-sketch path.
+        ``np.unique`` sorts by value and ``argsort(kind="stable")`` breaks
+        count ties by ascending category, so a merge of per-host sketches
+        reproduces the single-host ordering exactly."""
+        distinct = np.asarray(distinct, dtype=np.int64)
+        counts = np.asarray(counts, dtype=np.int64)
+        n_distinct_all = len(distinct)
         order = np.argsort(-counts, kind="stable")
         distinct, counts = distinct[order], counts[order]
         # cut rare categories: keep at most max_bin-1 cats and drop ultra-rare tail
@@ -264,7 +365,7 @@ class BinMapper:
             missing_type=MISSING_NAN if use_missing else MISSING_NONE,
             cat_values=distinct,
         )
-        m.is_trivial = keep <= 1 and len(np.unique(cats)) <= 1
+        m.is_trivial = keep <= 1 and n_distinct_all <= 1
         m.default_bin = 0
         return m
 
@@ -314,6 +415,107 @@ class BinMapper:
         if self.bin_type == BIN_CATEGORICAL:
             return ":".join(str(int(c)) for c in self.cat_values)
         return f"[{self.min_value}:{self.max_value}]"
+
+
+@dataclass
+class FeatureSketch:
+    """Exact mergeable quantile sketch of one feature over one data shard.
+
+    The reference's distributed bin finding reduces per-machine samples
+    through its Network layer (DataParallelTreeLearner + dataset_loader's
+    SampleData sync); our analog is this sketch: the sorted distinct nonzero
+    values with exact multiplicities plus the zero/NaN/total tallies. Merging
+    is the union of distincts with summed counts — commutative and associative
+    by construction, and ``from_sketch`` on a merge is bit-identical to
+    ``from_sample`` on the concatenated data because ``from_sample`` itself
+    starts from ``np.unique(nonzero, return_counts=True)``.
+
+    For categorical features ``distinct`` holds the category values (exact
+    int64 stored as float64 on the wire) including implicit zeros, and
+    ``zero_cnt`` stays 0.
+    """
+    bin_type: int = BIN_NUMERICAL
+    distinct: np.ndarray = field(
+        default_factory=lambda: np.array([], dtype=np.float64))
+    counts: np.ndarray = field(
+        default_factory=lambda: np.array([], dtype=np.int64))
+    zero_cnt: int = 0
+    na_cnt: int = 0
+    total_cnt: int = 0
+
+
+def sketch_feature(values: np.ndarray, total_cnt: int,
+                   bin_type: int = BIN_NUMERICAL) -> FeatureSketch:
+    """Sketch one feature's sampled values (this shard only).
+
+    Same input convention as :meth:`BinMapper.from_sample`: ``values`` may
+    contain NaN, and ``total_cnt > len(values)`` means the remainder are
+    implicit zeros.
+    """
+    values = np.asarray(values, dtype=np.float64)
+    if bin_type == BIN_CATEGORICAL:
+        na_mask = np.isnan(values) | (values < 0)
+        cats = values[~na_mask].astype(np.int64)
+        implicit_zeros = max(0, total_cnt - len(values))
+        if implicit_zeros:
+            cats = np.concatenate(
+                [cats, np.zeros(implicit_zeros, dtype=np.int64)])
+        distinct, counts = np.unique(cats, return_counts=True)
+        return FeatureSketch(
+            bin_type=BIN_CATEGORICAL,
+            distinct=distinct.astype(np.float64),
+            counts=counts.astype(np.int64),
+            zero_cnt=0,
+            na_cnt=int(na_mask.sum()),
+            total_cnt=int(total_cnt),
+        )
+    na_cnt = int(np.isnan(values).sum())
+    vals = values[~np.isnan(values)]
+    implicit_zeros = max(0, total_cnt - len(values))
+    zero_cnt = implicit_zeros + int((np.abs(vals) < K_ZERO_THRESHOLD).sum())
+    nonzero = vals[np.abs(vals) >= K_ZERO_THRESHOLD]
+    distinct, counts = np.unique(nonzero, return_counts=True)
+    return FeatureSketch(
+        bin_type=BIN_NUMERICAL,
+        distinct=distinct,
+        counts=counts.astype(np.int64),
+        zero_cnt=int(zero_cnt),
+        na_cnt=na_cnt,
+        total_cnt=int(total_cnt),
+    )
+
+
+def merge_sketches(sketches: Sequence[FeatureSketch]) -> FeatureSketch:
+    """Merge per-shard sketches of ONE feature: union of distinct values with
+    summed counts. Order-invariant and associative (``np.unique`` sorts and
+    integer addition commutes), so any reduction tree over any host ordering
+    yields the identical merged sketch."""
+    sketches = list(sketches)
+    if not sketches:
+        return FeatureSketch()
+    bt = sketches[0].bin_type
+    for s in sketches:
+        if s.bin_type != bt:
+            raise ValueError("merge_sketches: mixed bin_type sketches")
+    alld = np.concatenate(
+        [np.asarray(s.distinct, dtype=np.float64) for s in sketches])
+    allc = np.concatenate(
+        [np.asarray(s.counts, dtype=np.int64) for s in sketches])
+    if len(alld):
+        distinct, inverse = np.unique(alld, return_inverse=True)
+        counts = np.zeros(len(distinct), dtype=np.int64)
+        np.add.at(counts, np.asarray(inverse).ravel(), allc)
+    else:
+        distinct = np.array([], dtype=np.float64)
+        counts = np.array([], dtype=np.int64)
+    return FeatureSketch(
+        bin_type=bt,
+        distinct=distinct,
+        counts=counts,
+        zero_cnt=int(sum(s.zero_cnt for s in sketches)),
+        na_cnt=int(sum(s.na_cnt for s in sketches)),
+        total_cnt=int(sum(s.total_cnt for s in sketches)),
+    )
 
 
 @dataclass

@@ -143,11 +143,14 @@ _PARAMS: Dict[str, Tuple[Any, Tuple[str, ...]]] = {
     "is_provide_training_metric": (False, ("training_metric", "is_training_metric", "train_metric")),
     "eval_at": ([1, 2, 3, 4, 5], ("ndcg_eval_at", "ndcg_at", "map_eval_at", "map_at")),
     # ---- network ----
-    "num_machines": (1, ("num_machine",)),
+    # num_hosts is the pod-scale spelling (parallel/multihost.py): one
+    # jax.distributed process per host
+    "num_machines": (1, ("num_machine", "num_hosts")),
     "local_listen_port": (12400, ("local_port", "port")),
     "time_out": (120, ()),
     "machine_list_filename": ("", ("machine_list_file", "machine_list", "mlist")),
-    "machines": ("", ("workers", "nodes")),
+    # first entry is the jax.distributed coordinator, hence the alias
+    "machines": ("", ("workers", "nodes", "coordinator_address")),
     # ---- GPU/TPU device ----
     "gpu_platform_id": (-1, ()),
     "gpu_device_id": (-1, ()),
@@ -177,6 +180,16 @@ _PARAMS: Dict[str, Tuple[Any, Tuple[str, ...]]] = {
     # devices on accelerator backends; 1 on the cpu backend where extra
     # devices are virtual), 1 = force single-chip, k = shard over k devices
     "num_shards": (0, ("data_shards",)),
+    # feature shards of the 2-D (data, feature) mesh (parallel/mesh.py
+    # FEATURE_AXIS): 0/1 = 1-D data-parallel mesh; k>1 slices the grower's
+    # histogram allreduce into F/k feature blocks per device. Needs
+    # num_shards * feature_shards devices; clamped to a divisor of the
+    # trained feature count.
+    "feature_shards": (0, ("num_feature_shards",)),
+    # voting-parallel top-k histogram exchange on the depthwise grower
+    # (reference: PV-Tree / VotingParallelTreeLearner) without having to
+    # switch tree_learner; uses the top_k knob for the election size
+    "voting_parallel": (0, ("use_voting_parallel",)),
     # ---- cold-start pipeline (new in this framework; see ingest.py/prewarm.py) ----
     # rows per streamed ingest chunk (encode -> H2D -> commit pipeline);
     # ~56 MB of uint8 bins at 28 features — big enough for full tunnel
@@ -489,8 +502,15 @@ class Config:
             log.fatal("encode_threads must be >= 0 (0 = auto)")
         if self.num_shards < 0:
             log.fatal("num_shards must be >= 0 (0 = auto)")
+        if self.feature_shards < 0:
+            log.fatal("feature_shards must be >= 0 (0/1 = 1-D mesh)")
+        if self.voting_parallel and self.top_k < 1:
+            log.fatal("voting_parallel requires top_k >= 1")
         if not self.mesh_axis:
             log.fatal("mesh_axis must be a non-empty axis name")
+        if self.feature_shards > 1 and self.mesh_axis == "feature":
+            log.fatal("mesh_axis must differ from the reserved 'feature' "
+                      "axis of the 2-D mesh")
         if self.network_retries < 1:
             log.fatal("network_retries must be >= 1")
         if self.on_device_fault not in ("fatal", "reshard", "fallback_single"):

@@ -239,18 +239,23 @@ def train(params: Dict[str, Any], train_set: Dataset,
             # every process): atomic model text + state sidecar + manifest
             # with keep-last-N retention, written with backoff retries; a
             # snapshot that still fails is WARNED, training continues
-            if conf.snapshot_freq > 0 and (i + 1) % conf.snapshot_freq == 0 \
-                    and snap.is_writer_rank():
+            if conf.snapshot_freq > 0 and (i + 1) % conf.snapshot_freq == 0:
                 es_state = None
                 for c in callbacks:
                     exp = getattr(c, "_es_export", None)
                     if exp is not None:
                         es_state = exp()
                 try:
-                    path = snap.write_snapshot(
-                        booster, snapshot_dir, i + 1,
-                        keep=conf.snapshot_keep, es_state=es_state)
-                    log.info("Saved snapshot to %s", path)
+                    if snap.is_writer_rank():
+                        path = snap.write_snapshot(
+                            booster, snapshot_dir, i + 1,
+                            keep=conf.snapshot_keep, es_state=es_state)
+                        log.info("Saved snapshot to %s", path)
+                    elif booster._gbdt is not None:
+                        # pod: get_resume_state allgathers sharded trainer
+                        # state — a COLLECTIVE every rank must enter even
+                        # though only the writer rank touches the disk
+                        booster._gbdt.get_resume_state()
                 except Exception as e:
                     log.warning(f"snapshot at iteration {i + 1} failed after "
                                 f"retries ({type(e).__name__}: {e}); "

@@ -121,7 +121,7 @@ def overlap_efficiency(stage_spans, wall_s: float) -> float:
 def stream_encode_upload(raw, mappers, meta, *, width: int,
                          chunk_rows: int, encode_threads: int = 0,
                          phases: Optional[Dict[str, Any]] = None,
-                         shard_plan=None, encode_fn=None):
+                         shard_plan=None, encode_fn=None, row0: int = 0):
     """Run the three-stage pipeline over ``raw`` [N, F_raw] and return the
     device bin matrix: [N, width] uint8 on one device, or — with a
     ``shard_plan`` (parallel/mesh.RowShardPlan) — a global
@@ -143,16 +143,22 @@ def stream_encode_upload(raw, mappers, meta, *, width: int,
     from .efb import apply_bundles
 
     n = int(raw.shape[0])
-    if n == 0:
+    if n == 0 and shard_plan is None:
         return jnp.zeros((0, width), jnp.uint8)
     chunk_rows = max(1, int(chunk_rows))
+    proc = jax.process_index()
     if shard_plan is not None:
         # chunk grid aligned to the shard grid: every chunk lies inside ONE
         # shard's row block, so the uploader can target the owning device
-        # and commits stay single-device dynamic-update-slices
+        # and commits stay single-device dynamic-update-slices. In pod mode
+        # (a plan whose mesh spans processes) each host only builds tasks for
+        # the shards IT owns; ``row0`` translates the plan's global row
+        # coordinates into indices of this host's local ``raw`` slice.
         chunk_rows = min(chunk_rows, shard_plan.rows_per_shard)
         tasks = []
         for s in range(shard_plan.num_shards):
+            if shard_plan.devices[s].process_index != proc:
+                continue
             lo, hi = shard_plan.shard_rows_range(s)
             tasks.extend((s, g0, min(g0 + chunk_rows, hi))
                          for g0 in range(lo, hi, chunk_rows))
@@ -191,9 +197,9 @@ def stream_encode_upload(raw, mappers, meta, *, width: int,
             try:
                 t0 = time.perf_counter()
                 if encode_fn is not None:
-                    cb = encode_fn(raw[g0:g1])
+                    cb = encode_fn(raw[g0 - row0:g1 - row0])
                 else:
-                    cb = bin_data(raw[g0:g1], mappers).bins
+                    cb = bin_data(raw[g0 - row0:g1 - row0], mappers).bins
                     if meta is not None:
                         cb = apply_bundles(cb, meta)
                 cb = np.ascontiguousarray(cb)
@@ -348,14 +354,25 @@ def stream_encode_upload(raw, mappers, meta, *, width: int,
         return state["acc"]
     # stitch the per-shard buffers into ONE global row-sharded array — no
     # copy: every buffer already lives on its owning device and the plan's
-    # contiguous blocks are the NamedSharding layout
+    # contiguous blocks are the NamedSharding layout. In pod mode each host
+    # contributes only the buffers for ITS shards (legal: multiprocess
+    # make_array_from_single_device_arrays takes addressable buffers only).
+    # With a 2-D (data, feature) mesh the row block is replicated across the
+    # shard's feature-axis devices — all local, so the replication copies
+    # never cross hosts.
     arrays = []
     for s in range(shard_plan.num_shards):
+        if shard_plan.devices[s].process_index != proc:
+            continue
         a = state["accs"].get(s)
         if a is None:   # shard holds only padding rows (n < num_shards * rps)
             a = _device_zeros((shard_plan.rows_per_shard, width), jnp.uint8,
                               shard_plan.devices[s])
         arrays.append(a)
+        row_devs = (shard_plan.row_devices(s)
+                    if hasattr(shard_plan, "row_devices") else [])
+        for d in row_devs[1:]:
+            arrays.append(jax.device_put(a, d))
     return jax.make_array_from_single_device_arrays(
         (shard_plan.n_padded, width), shard_plan.sharding(2), arrays)
 
@@ -378,19 +395,20 @@ def _grow_plan(plan):
     device count); None when the plan cannot grow."""
     if plan is None:
         return None
-    nd = jax.device_count()
+    fs = int(getattr(plan, "feature_shards", 1) or 1)
+    nd = jax.device_count() // fs
     if plan.num_shards >= nd:
         return None
     from .parallel.mesh import plan_row_sharding
     return plan_row_sharding(plan.n_rows, min(nd, plan.num_shards * 2),
-                             axis_name=plan.axis_name)
+                             axis_name=plan.axis_name, feature_shards=fs)
 
 
 def stream_with_recovery(raw, mappers, meta, *, width: int, chunk_rows: int,
                          encode_threads: int = 0,
                          phases: Optional[Dict[str, Any]] = None,
                          shard_plan=None, policy: str = "reshard",
-                         sleep=time.sleep, encode_fn=None):
+                         sleep=time.sleep, encode_fn=None, row0: int = 0):
     """:func:`stream_encode_upload` with OOM-adaptive degradation.
 
     A device-level fault during the pipeline (XLA ``RESOURCE_EXHAUSTED`` on
@@ -416,6 +434,12 @@ def stream_with_recovery(raw, mappers, meta, *, width: int, chunk_rows: int,
     from .utils.retry import backoff_delays
 
     plan = shard_plan
+    # a plan whose mesh spans processes (pod mode) must keep the SAME shard
+    # grid on every host — re-planning or dropping to single-device here would
+    # diverge the global sharding this host commits into. Chunk halving stays
+    # available (it is grid-preserving); the plan-changing rungs are disabled.
+    multiproc = plan is not None and any(
+        d.process_index != jax.process_index() for d in plan.mesh.devices.flat)
     rows = max(1, int(chunk_rows))
     halvings = 0
     attempt = 0
@@ -426,7 +450,7 @@ def stream_with_recovery(raw, mappers, meta, *, width: int, chunk_rows: int,
             bins = stream_encode_upload(
                 raw, mappers, meta, width=width, chunk_rows=rows,
                 encode_threads=encode_threads, phases=phases,
-                shard_plan=plan, encode_fn=encode_fn)
+                shard_plan=plan, encode_fn=encode_fn, row0=row0)
             return bins, plan, rows
         except BaseException as e:
             if policy == "fatal" or not faults.is_device_fault(e):
@@ -445,14 +469,15 @@ def stream_with_recovery(raw, mappers, meta, *, width: int, chunk_rows: int,
                     f"device fault during ingest ({type(e).__name__}: {e}); "
                     f"halving chunk to {rows} rows and retrying "
                     f"({halvings}/{MAX_CHUNK_HALVINGS})")
-            elif policy == "reshard" and (grown := _grow_plan(plan)) is not None:
+            elif (policy == "reshard" and not multiproc
+                  and (grown := _grow_plan(plan)) is not None):
                 plan = grown
                 after = plan.num_shards
                 action = "reshard"
                 log.warning(
                     f"device fault persists after chunk halving; re-planning "
                     f"row sharding {before} -> {after} shards")
-            elif policy == "fallback_single" and plan is not None:
+            elif policy == "fallback_single" and not multiproc and plan is not None:
                 plan = None
                 after = 1
                 action = "fallback_single"

@@ -145,7 +145,8 @@ class GBDT:
                     # pass feature-tiled so one [2*(L//2), 3, ft, B] tile
                     # fits the budget
                     incompat = []
-                    if config.tree_learner == "voting":
+                    if (config.tree_learner == "voting"
+                            or int(getattr(config, "voting_parallel", 0))):
                         incompat.append("voting-parallel")
                     if config.tree_learner == "feature":
                         # feature sharding already bounds per-shard width
@@ -205,7 +206,9 @@ class GBDT:
                 cegb_coupled=cegb_coupled_v is not None,
                 cegb_lazy=cegb_lazy_v is not None),
             hist_impl=config.histogram_impl,
-            voting_top_k=(config.top_k if config.tree_learner == "voting"
+            voting_top_k=(config.top_k
+                          if (config.tree_learner == "voting"
+                              or int(getattr(config, "voting_parallel", 0)))
                           else 0),
             ff_bynode=config.feature_fraction_bynode,
             hist_pool=hist_pool,
@@ -217,7 +220,8 @@ class GBDT:
                 "(10-24x slower; see docs/PERF_NOTES.md) and its "
                 "implementation is archived on branch archive/packed-levels; "
                 "the flag is ignored")
-        if (config.tree_learner == "voting"
+        if ((config.tree_learner == "voting"
+             or int(getattr(config, "voting_parallel", 0)))
                 and config.grow_policy != "depthwise"):
             log.warning("tree_learner=voting is only implemented for the "
                         "depthwise grower; falling back to plain "
@@ -292,6 +296,17 @@ class GBDT:
         # DataParallelTreeLearner being implied by num_machines)
         plan = getattr(train_set, "shard_plan", None)
         self._plan = plan
+        # pod mode: the plan's mesh spans jax processes. Every piece of
+        # row-length trainer state must then be a GLOBAL array — a
+        # single-device train_score cannot feed a computation over the pod
+        # mesh. Each host computed the identical initial score (labels are
+        # allgathered at construct), so replication is exact.
+        from ..parallel.multihost import plan_spans_processes
+        self._pod = plan_spans_processes(plan)
+        if self._pod:
+            from ..parallel.multihost import replicate_global
+            self.train_score = replicate_global(
+                np.asarray(self.train_score, np.float32), plan.mesh)
         self._dp = (config.tree_learner in ("data", "data_parallel", "voting")
                     and len(jax.devices()) > 1) or plan is not None
         # feature-parallel (#25): full data replicated, features sharded,
@@ -747,7 +762,15 @@ class GBDT:
             from ..ops.grow_depthwise import CEGBState
             mesh = self._mesh
             axis = mesh.axis_names[0]
-            gp_grow = dataclasses.replace(gp, axis_name=axis)
+            # 2-D (data, feature) mesh: rows replicate over the feature axis
+            # (in_specs below leave it unused) and the grower's histogram
+            # allreduce slices by feature block (_hist_allreduce)
+            feat_kw = {}
+            if (self._plan is not None
+                    and getattr(self._plan, "feature_shards", 1) > 1):
+                feat_kw = dict(feature_axis_name=self._plan.feature_axis,
+                               feature_shards=self._plan.feature_shards)
+            gp_grow = dataclasses.replace(gp, axis_name=axis, **feat_kw)
             pad_rows, n_orig = self._pad_rows, self._n_orig
             # CEGB under the data-parallel learner (VERDICT r4 weak #6):
             # the per-(row, feature) lazy bitset shards over rows with the
@@ -1063,7 +1086,12 @@ class GBDT:
             bag = self._bag_mask
         else:
             if not hasattr(self, "_bag_ones"):
-                self._bag_ones = jnp.ones(n, dtype=jnp.float32)
+                if getattr(self, "_pod", False):
+                    from ..parallel.multihost import replicate_global
+                    self._bag_ones = replicate_global(
+                        np.ones(n, np.float32), self._plan.mesh)
+                else:
+                    self._bag_ones = jnp.ones(n, dtype=jnp.float32)
             bag = self._bag_ones
         dummy = jnp.zeros((), jnp.float32)
         shrink = 1.0 if self.average_output else self.learning_rate
@@ -1085,6 +1113,8 @@ class GBDT:
                 hess if custom else dummy,
                 jnp.float32(shrink), jnp.int32(self.iter_),
                 jnp.float32(self.iter_ + 1), cegb_in, bt_in, aux_in)
+        if getattr(self, "_pod", False):
+            args = self._podify_args(args)
         def _dispatch():
             if self._dp:
                 # chaos point: host side of the fused-step dispatch whose
@@ -1156,6 +1186,29 @@ class GBDT:
                 unst = self._unstack_fn = jax.jit(_unstack)   # tpu-lint: disable=retrace-hazard
             trees = list(unst(stacked, lids))
         return trees, new_score, cegb_out, ok
+
+    def _podify_args(self, args):
+        """Pod mode: every step input must be a GLOBAL array. Inputs already
+        spanning devices (the sharded bins matrix, previous-step outputs)
+        pass through untouched; anything host-side or committed to a single
+        local device (scores on iteration 0, metadata vectors, scalars,
+        custom gradients) replicates over the plan's mesh — every process
+        holds the identical value by construction, so replication is exact
+        and cheap (row vectors and scalars, never the feature matrix)."""
+        from ..parallel.multihost import replicate_global
+        mesh = self._plan.mesh
+
+        def conv(a):
+            if isinstance(a, jax.Array):
+                if len(a.sharding.device_set) > 1:
+                    return a
+                return replicate_global(np.asarray(a), mesh)
+            if isinstance(a, (np.ndarray, np.generic, int, float)):
+                return replicate_global(np.asarray(a), mesh)
+            return a
+
+        return jax.tree.map(conv, args,
+                            is_leaf=lambda x: isinstance(x, jax.Array))
 
     def _obs_track_compiles(self, key: str, fn) -> None:
         """Compile/retrace telemetry: poll the jitted step's executable-cache
@@ -1775,7 +1828,16 @@ class GBDT:
         # f64 for the same losslessness reason as get_resume_state; stays host
         self.init_scores = np.asarray(   # tpu-lint: disable=dtype-drift
             arrays["init_scores"], dtype=np.float64)
-        self.train_score = jnp.asarray(arrays["train_score"])
+        if getattr(self, "_pod", False):
+            # resume onto a pod mesh (possibly from a snapshot taken at a
+            # different host count): the unsharded snapshot score must come
+            # back as a GLOBAL array, same as at construction
+            from ..parallel.multihost import replicate_global
+            self.train_score = replicate_global(
+                np.asarray(arrays["train_score"], np.float32),
+                self._plan.mesh)
+        else:
+            self.train_score = jnp.asarray(arrays["train_score"])
         self._bag_key = jnp.asarray(arrays["bag_key"])
         self._bag_mask = (jnp.asarray(arrays["bag_mask"])
                           if "bag_mask" in arrays else None)

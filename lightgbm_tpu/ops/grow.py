@@ -71,6 +71,13 @@ class GrowParams:
     # reference's entire ReduceScatter+Allgather machinery (network.cpp) becomes
     # these two collectives; split selection is computed replicated on all shards.
     axis_name: str = ""
+    # Optional second mesh axis of a 2-D (data, feature) mesh (reference:
+    # VotingParallelTreeLearner's column partition). Rows stay replicated over
+    # it; _hist_allreduce slices every histogram psum by feature block so each
+    # device's data-axis collective volume drops by feature_shards — the
+    # reference's ReduceScatter+Allgather (network.cpp) along the feature dim.
+    feature_axis_name: str = ""
+    feature_shards: int = 1
     # static spec of a built-in objective whose gradients the depthwise
     # grower recomputes in-register (ObjectiveFunction.fused_grad_spec):
     # ("l2",) or ("logloss", sigmoid, lw_pos, lw_neg). When set, the grower
@@ -84,6 +91,30 @@ def _psum(x, gp: "GrowParams"):
     if gp.axis_name:
         return jax.lax.psum(x, gp.axis_name)
     return x
+
+
+def _hist_allreduce(hist, gp: "GrowParams", f_dim: int):
+    """Allreduce a histogram-shaped array over the data axis.
+
+    On a 1-D mesh this is a plain ``psum``. On a 2-D (data, feature) mesh each
+    device first slices its own feature block (``axis_index`` along the
+    feature axis), psums ONLY that block over the data axis, then rebuilds the
+    full histogram with a tiled ``all_gather`` over the feature axis — the
+    per-device data-axis collective shrinks by ``feature_shards`` while the
+    result stays bit-identical (psum is elementwise, so psum-of-slice
+    concatenated equals the full psum).
+    """
+    if not gp.axis_name:
+        return hist
+    fa, k = gp.feature_axis_name, gp.feature_shards
+    F = hist.shape[f_dim]
+    if not fa or k <= 1 or F % k != 0:
+        return jax.lax.psum(hist, gp.axis_name)
+    blk = F // k
+    j = jax.lax.axis_index(fa)
+    sub = jax.lax.dynamic_slice_in_dim(hist, j * blk, blk, axis=f_dim)
+    sub = jax.lax.psum(sub, gp.axis_name)
+    return jax.lax.all_gather(sub, fa, axis=f_dim, tiled=True)
 
 
 class TreeArrays(NamedTuple):

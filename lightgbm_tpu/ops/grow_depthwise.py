@@ -33,7 +33,8 @@ import jax
 import jax.numpy as jnp
 
 from . import histogram as H
-from .grow import GrowParams, TreeArrays, _empty_tree, _psum
+from .grow import (GrowParams, TreeArrays, _empty_tree, _hist_allreduce,
+                   _psum)
 from .split import (NEG_INF, SplitParams, SplitResult, best_split,
                     leaf_output, per_feature_gains)
 
@@ -289,7 +290,7 @@ def grow_tree_depthwise(bins: jnp.ndarray, g: jnp.ndarray, h: jnp.ndarray,
         quant, hist0 = H.grad_quant_hist0(
             bins, f_score, f_aux, f_bag, qseed, gp.fused_obj, B,
             const_hess=gp.const_hess, impl=gp.hist_impl, bins_T=bins_T)
-        hist0 = _psum(hist0, gp)
+        hist0 = _hist_allreduce(hist0, gp, f_dim=1)
     else:
         # int8 quantized channels, built once per tree; per-shard scales are
         # fine under data-parallel because every histogram is dequantized to
@@ -300,9 +301,10 @@ def grow_tree_depthwise(bins: jnp.ndarray, g: jnp.ndarray, h: jnp.ndarray,
         # archived on branch `archive/packed-levels`: row compaction measured
         # 10-24x slower on this runtime — per-level XLA gathers dominate. See
         # docs/PERF_NOTES.md "negative results".)
-        hist0 = _psum(H.hist_leaf(bins, g, h, c, B, gp.hist_impl,
-                                  bins_T=bins_T, quant=quant),
-                      gp)                                            # [3, F, B]
+        hist0 = _hist_allreduce(
+            H.hist_leaf(bins, g, h, c, B, gp.hist_impl,
+                        bins_T=bins_T, quant=quant),
+            gp, f_dim=1)                                             # [3, F, B]
     g0 = hist0[0, 0].sum()
     h0 = hist0[1, 0].sum()
     c0 = hist0[2, 0].sum()
@@ -564,7 +566,7 @@ def grow_tree_depthwise(bins: jnp.ndarray, g: jnp.ndarray, h: jnp.ndarray,
             vote_mask = _scatter_set(st.vote_mask, leaves_iota, em_rows, sel)
             vote_mask = _scatter_set(vote_mask, new_leaf, em_rows, sel)
         else:
-            hist_pass = _psum(hist_pass, gp)
+            hist_pass = _hist_allreduce(hist_pass, gp, f_dim=2)
             vote_mask = None
 
         if voting:

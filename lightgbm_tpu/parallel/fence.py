@@ -40,6 +40,9 @@ FENCE_CONFIG_FIELDS = (
     # mesh topology: ranks that disagree on the shard grid dispatch
     # incompatible collectives (mismatched psum shapes hang, they don't err)
     "num_shards", "mesh_axis", "on_device_fault",
+    # 2-D mesh + voting-parallel: a rank slicing a different feature block
+    # (or skipping the vote psum) desynchronizes the collective schedule
+    "feature_shards", "voting_parallel", "top_k",
 )
 
 
@@ -85,8 +88,28 @@ def fence_items(config, train_set=None) -> List[Tuple[str, bytes]]:
                   b"none" if plan is None
                   else repr((plan.axis_name, int(plan.num_shards),
                              int(plan.n_rows),
-                             int(plan.rows_per_shard))).encode()))
+                             int(plan.rows_per_shard),
+                             int(getattr(plan, "feature_shards", 1) or 1),
+                             getattr(plan, "feature_axis", "") or "",
+                             )).encode()))
+    items.append(("host.topology", _topology_bytes()))
     return items
+
+
+def _topology_bytes() -> bytes:
+    """Process count + each process's device census. Ranks that see different
+    pod shapes (one host lost a chip, one joined with a stale slice count)
+    would build incompatible meshes; hashing the census catches it at the
+    fence instead of at the first hanging collective."""
+    import jax
+    try:
+        census = sorted(
+            (int(getattr(d, "process_index", 0)), str(getattr(d, "platform",
+                                                              "")))
+            for d in jax.devices())
+        return repr((int(jax.process_count()), census)).encode()
+    except Exception:
+        return b"unknown"
 
 
 def consistency_fence(config, train_set=None, raise_on_mismatch: bool = True
@@ -194,7 +217,21 @@ def mesh_preflight(config, train_set, plan,
     if ts_n is not None and int(ts_n) != n_rows:
         problems.append(f"  plan.n_rows: plan={n_rows} "
                         f"train_set.num_data={int(ts_n)}")
-    problems.extend(probe_device_liveness(devices))
+    fs = int(getattr(plan, "feature_shards", 1) or 1)
+    if fs > 1 and not getattr(plan, "feature_axis", ""):
+        problems.append(f"  plan.feature_shards={fs} but feature_axis unset")
+    # liveness probing is a device_put, which only ADDRESSABLE devices accept;
+    # remote hosts probe their own slice and the fence below cross-checks the
+    # census, so every device in the pod is covered exactly once
+    try:
+        proc = jax.process_index()
+    except Exception:
+        proc = 0
+    mesh = getattr(plan, "mesh", None)
+    all_devs = (list(mesh.devices.flat) if mesh is not None else devices)
+    local_devs = [d for d in all_devs
+                  if int(getattr(d, "process_index", 0)) == proc]
+    problems.extend(probe_device_liveness(local_devs))
     nproc = 1
     fence_ok = True
     if not problems:

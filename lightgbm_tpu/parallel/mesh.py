@@ -18,11 +18,16 @@ from jax.sharding import Mesh, NamedSharding, PartitionSpec as P
 from ..utils import log
 
 DATA_AXIS = "data"
+# second mesh axis for the optional 2-D ("data","feature") mesh (reference
+# analog: FeatureParallelTreeLearner / VotingParallelTreeLearner column
+# partitions, feature_parallel_tree_learner.cpp) — histogram allreduce volume
+# per device drops by the feature-shard count (sliced psum + tiled all_gather)
+FEATURE_AXIS = "feature"
 
 
 @dataclasses.dataclass(frozen=True)
 class RowShardPlan:
-    """Row partition of an [N, ...] matrix over a 1-D device mesh.
+    """Row partition of an [N, ...] matrix over a 1-D (or 2-D) device mesh.
 
     The plan is pure metadata (mesh + row arithmetic) so it can be derived
     BEFORE the binned matrix exists — Dataset.construct publishes it ahead of
@@ -32,12 +37,19 @@ class RowShardPlan:
     which is exactly how ``NamedSharding(mesh, P(axis, None))`` lays out the
     leading axis, so per-shard buffers assemble into the global array with
     ``jax.make_array_from_single_device_arrays`` and zero relayout.
+
+    With ``feature_shards > 1`` the mesh is 2-D ``(data, feature)``: rows stay
+    blocked over the data axis and REPLICATED over the feature axis (the bins
+    spec is still ``P(data, None)``); the feature axis exists purely so the
+    grower's histogram allreduce can slice by feature block.
     """
     mesh: Mesh
     axis_name: str
     num_shards: int
     n_rows: int            # true (unpadded) row count
     rows_per_shard: int    # ceil(n_rows / num_shards)
+    feature_shards: int = 1
+    feature_axis: str = FEATURE_AXIS
 
     @property
     def n_padded(self) -> int:
@@ -49,7 +61,18 @@ class RowShardPlan:
 
     @property
     def devices(self) -> List:
+        """One OWNING device per row shard (the feature-axis leader when the
+        mesh is 2-D) — the ingest pipeline commits each row block here."""
+        if self.feature_shards > 1:
+            return [self.mesh.devices[s, 0] for s in range(self.num_shards)]
         return list(self.mesh.devices.flat)
+
+    def row_devices(self, s: int) -> List:
+        """Every device holding a copy of row shard ``s`` (one on a 1-D mesh;
+        the whole mesh row on a 2-D mesh, since bins replicate over feature)."""
+        if self.feature_shards > 1:
+            return list(self.mesh.devices[s, :])
+        return [self.mesh.devices.flat[s]]
 
     def sharding(self, ndim: int = 2) -> NamedSharding:
         """Leading-axis row sharding for an ndim-dimensional array."""
@@ -87,16 +110,47 @@ def resolve_num_shards(requested: int) -> int:
     return nd if (nd > 1 and platform != "cpu") else 1
 
 
+def resolve_feature_shards(requested: int, num_features: int,
+                           num_shards: int) -> int:
+    """Resolve the ``feature_shards`` knob (0/1 = off) for a 2-D mesh.
+
+    The sliced histogram allreduce needs the padded feature axis to divide
+    evenly, so a non-divisor request clamps DOWN to the largest divisor of
+    ``num_features``; the total ``num_shards * feature_shards`` devices must
+    exist."""
+    fs = int(requested or 0)
+    if fs <= 1 or num_shards <= 1:
+        return 1
+    nd = jax.device_count()
+    max_fs = max(1, nd // max(1, num_shards))
+    if fs > max_fs:
+        log.warning(f"feature_shards={fs} needs {num_shards}x{fs} devices but "
+                    f"only {nd} exist; clamping to {max_fs}")
+        fs = max_fs
+    if num_features > 0 and num_features % fs != 0:
+        d = fs
+        while d > 1 and num_features % d != 0:
+            d -= 1
+        log.warning(f"feature_shards={fs} does not divide {num_features} "
+                    f"features; clamping to divisor {d}")
+        fs = d
+    return max(1, fs)
+
+
 def plan_row_sharding(n_rows: int, num_shards: int,
-                      axis_name: str = DATA_AXIS) -> Optional[RowShardPlan]:
+                      axis_name: str = DATA_AXIS,
+                      feature_shards: int = 1) -> Optional[RowShardPlan]:
     """Build the row-shard plan, or None when one shard (single-chip path)."""
     if num_shards <= 1 or n_rows <= 0:
         return None
-    mesh = make_mesh(num_shards, axis_name=axis_name)
+    feature_shards = max(1, int(feature_shards))
+    mesh = make_mesh(num_shards * feature_shards, axis_name=axis_name,
+                     feature_shards=feature_shards)
     rps = -(-n_rows // num_shards)   # ceil
     return RowShardPlan(mesh=mesh, axis_name=axis_name,
                         num_shards=num_shards, n_rows=int(n_rows),
-                        rows_per_shard=int(rps))
+                        rows_per_shard=int(rps),
+                        feature_shards=feature_shards)
 
 
 def shard_map_compat(f, *, mesh, in_specs, out_specs, check_vma=False):
@@ -124,11 +178,17 @@ def mesh_context(mesh: Mesh):
 
 
 def make_mesh(num_devices: Optional[int] = None, axis_name: str = DATA_AXIS,
-              devices: Optional[Sequence] = None) -> Mesh:
-    """1-D data-parallel mesh over the available devices."""
+              devices: Optional[Sequence] = None,
+              feature_shards: int = 1,
+              feature_axis: str = FEATURE_AXIS) -> Mesh:
+    """1-D data-parallel mesh, or 2-D (data, feature) when feature_shards > 1."""
     devs = list(devices) if devices is not None else jax.devices()
     if num_devices is not None:
         devs = devs[:num_devices]
+    if feature_shards > 1:
+        d = len(devs) // feature_shards
+        arr = np.array(devs[: d * feature_shards]).reshape(d, feature_shards)
+        return Mesh(arr, (axis_name, feature_axis))
     return Mesh(np.array(devs), (axis_name,))
 
 

@@ -1,0 +1,227 @@
+"""Pod-scale (multi-host) training bench: REAL multi-process runs at 1/2/4
+simulated hosts on one box, recording iters/sec, scaling efficiency, the
+analytic per-level allreduce volume (full 1-D psum vs the voting-parallel
+top-k exchange), and tree-hash equality across host counts.
+
+Every host count trains over the SAME 4-shard grid — 1 host x 4 devices,
+2 x 2, 4 x 1 — so the SPMD program is identical and the tree hashes must be
+byte-equal (gradients are lattice-rounded: multiples of 2^-9 with constant
+hessian, making every f32 histogram partial sum exact under ANY psum
+association, including gloo's cross-process rings). What changes with the
+host count is WHERE the collectives run: in-process for 1 host, over gloo
+CPU rings for 2/4 — i.e. the bench measures the cost of crossing process
+boundaries, which is the pod's marginal cost on real DCN.
+
+Scaling here is about OVERHEAD, not speedup: the simulated hosts share one
+CPU box, so ``scaling_efficiency = t(1 host) / t(k hosts)`` is the fraction
+of single-process throughput that survives the multi-process collectives
+(1.0 = free; the ``cores`` field records the sharing regime, same convention
+as scripts/bench_multichip.py).
+
+The collective-volume table uses
+:func:`lightgbm_tpu.parallel.multihost.level_collective_bytes`: voting-
+parallel moves two O(F) vote/score psums plus k elected columns instead of
+the full O(F*B) histogram, so ``voting_bytes < full_bytes`` from F >= 64 at
+any realistic (B, k) — the JSON records the crossover explicitly.
+
+Usage: python scripts/bench_pod.py [out.json]
+       (internal) python scripts/bench_pod.py --worker <port> <nhosts>
+                  <ndev_per_host> <datadir> <rounds>
+"""
+import json
+import os
+import socket
+import subprocess
+import sys
+import tempfile
+import time
+
+N_ROWS = int(os.environ.get("LGBM_TPU_POD_ROWS", 20_000))
+N_FEATURES = int(os.environ.get("LGBM_TPU_POD_FEATURES", 16))
+N_ROUNDS = int(os.environ.get("LGBM_TPU_POD_ITERS", 6))
+NUM_SHARDS = 4
+HOST_COUNTS = (1, 2, 4)
+
+
+def _lattice_fobj(preds, train_data):
+    import numpy as np
+    y = np.asarray(train_data.get_label(), np.float64)
+    p = 1.0 / (1.0 + np.exp(-np.asarray(preds, np.float64)))
+    g = np.round((p - y) * 512.0) / 512.0
+    return g.astype(np.float32), np.full(g.shape, 0.25, np.float32)
+
+
+def _tree_hash(model_text: str) -> str:
+    import hashlib
+    section = model_text.split("\nparameters:\n", 1)[0]
+    return hashlib.sha256(section.encode()).hexdigest()
+
+
+def _params():
+    return {
+        "objective": "binary", "num_leaves": 31, "max_bin": 32,
+        "min_data_in_leaf": 20, "learning_rate": 0.2, "verbosity": -1,
+        "enable_bundle": False, "grow_policy": "depthwise",
+        "num_shards": NUM_SHARDS, "boost_from_average": False,
+    }
+
+
+# ---------------------------------------------------------------- worker ----
+
+def worker(port: int, nhosts: int, ndev: int, datadir: str,
+           rounds: int) -> None:
+    os.environ["XLA_FLAGS"] = \
+        f"--xla_force_host_platform_device_count={ndev}"
+    os.environ.setdefault("JAX_PLATFORMS", "cpu")
+    import jax
+    jax.config.update("jax_platforms", "cpu")
+    if nhosts > 1:
+        jax.config.update("jax_cpu_collectives_implementation", "gloo")
+    import numpy as np
+    sys.path.insert(0, os.path.dirname(os.path.dirname(
+        os.path.abspath(__file__))))
+    import lightgbm_tpu as lgb
+    from lightgbm_tpu.parallel import multihost
+    from lightgbm_tpu.parallel.mesh import init_distributed, plan_row_sharding
+
+    params = _params()
+    if nhosts > 1:
+        from lightgbm_tpu.config import params_to_config
+        params["num_machines"] = nhosts
+        params["machines"] = ",".join(
+            [f"127.0.0.1:{port}"] + ["127.0.0.1:0"] * (nhosts - 1))
+        init_distributed(params_to_config(params))
+
+    xpath = os.path.join(datadir, "X.npy")
+    n_global = int(np.load(xpath, mmap_mode="r").shape[0])
+    plan = plan_row_sharding(n_global, NUM_SHARDS)
+    row0, row1 = multihost.host_row_range(plan)
+    X = multihost.load_file_shard(xpath, row0, row1)
+    y = multihost.load_file_shard(os.path.join(datadir, "y.npy"), row0, row1)
+
+    dtrain = lgb.Dataset(X, label=y, params=params)
+    ticks = []
+
+    def _tick(env):
+        ticks.append(time.perf_counter())
+
+    booster = lgb.train(params, dtrain, num_boost_round=rounds,
+                        fobj=_lattice_fobj, verbose_eval=False,
+                        callbacks=[_tick])
+    # iteration 1 pays the compile; steady-state rate is what a pod scales
+    steady = ticks[-1] - ticks[0]
+    ips = (len(ticks) - 1) / steady if steady > 0 else 0.0
+    if jax.process_index() == 0:
+        print(json.dumps({
+            "kind": "BENCHPOD", "num_hosts": nhosts,
+            "devices_per_host": ndev,
+            "iters_per_sec": round(ips, 4),
+            "steady_train_s": round(steady, 4),
+            "tree_hash": _tree_hash(booster.model_to_string()),
+        }))
+
+
+# ---------------------------------------------------------------- parent ----
+
+def _free_port() -> int:
+    s = socket.socket()
+    s.bind(("127.0.0.1", 0))
+    port = s.getsockname()[1]
+    s.close()
+    return port
+
+
+def _run_hosts(nhosts: int, datadir: str) -> dict:
+    ndev = NUM_SHARDS // nhosts
+    port = _free_port()
+    env_base = {k: v for k, v in os.environ.items() if k != "XLA_FLAGS"}
+    env_base["JAX_PLATFORMS"] = "cpu"
+    procs = []
+    for rank in range(nhosts):
+        env = dict(env_base)
+        env["JAX_PROCESS_ID"] = str(rank)
+        procs.append(subprocess.Popen(
+            [sys.executable, os.path.abspath(__file__), "--worker",
+             str(port), str(nhosts), str(ndev), datadir, str(N_ROUNDS)],
+            env=env, stdout=subprocess.PIPE, stderr=subprocess.STDOUT))
+    outs = []
+    for p in procs:
+        out, _ = p.communicate(timeout=900)
+        outs.append(out.decode("utf-8", "replace"))
+        if p.returncode != 0:
+            raise RuntimeError(
+                f"bench rank failed (rc={p.returncode}):\n{outs[-1][-3000:]}")
+    for o in outs:
+        for line in o.splitlines():
+            if line.startswith('{"kind": "BENCHPOD"'):
+                return json.loads(line)
+    raise RuntimeError("no BENCHPOD line:\n" + outs[0][-3000:])
+
+
+def _collective_table():
+    sys.path.insert(0, os.path.dirname(os.path.dirname(
+        os.path.abspath(__file__))))
+    from lightgbm_tpu.parallel.multihost import level_collective_bytes
+    rows = []
+    for F in (8, 64, 256, 1024):
+        vol = level_collective_bytes(F, 64, num_shards=NUM_SHARDS,
+                                     feature_shards=2, voting_top_k=16)
+        rows.append({"num_features": F, "max_bin": 64, "top_k": 16,
+                     **vol, "voting_lt_full":
+                         vol["voting_bytes"] < vol["full_bytes"]})
+    return rows
+
+
+def run(out_path=None) -> dict:
+    import multiprocessing
+    with tempfile.TemporaryDirectory(prefix="bench_pod_") as datadir:
+        import numpy as np
+        rng = np.random.RandomState(29)
+        X = rng.randn(N_ROWS, N_FEATURES)
+        w = rng.randn(N_FEATURES)
+        y = ((X @ w) / 2.0 + rng.randn(N_ROWS) * 0.5 > 0).astype(np.float64)
+        np.save(os.path.join(datadir, "X.npy"), X)
+        np.save(os.path.join(datadir, "y.npy"), y)
+
+        entries = []
+        for nhosts in HOST_COUNTS:
+            t0 = time.perf_counter()
+            e = _run_hosts(nhosts, datadir)
+            e["wall_s"] = round(time.perf_counter() - t0, 2)
+            entries.append(e)
+            print(f"# {nhosts} host(s) x {e['devices_per_host']} dev: "
+                  f"{e['iters_per_sec']} it/s", file=sys.stderr)
+
+    base = entries[0]["iters_per_sec"] or 1e-9
+    for e in entries:
+        e["scaling_efficiency"] = round(e["iters_per_sec"] / base, 4)
+    hashes = {e["tree_hash"] for e in entries}
+    result = {
+        "bench": "multihost_pod",
+        "rows": N_ROWS, "features": N_FEATURES, "iters": N_ROUNDS,
+        "num_shards": NUM_SHARDS,
+        "cores": multiprocessing.cpu_count(),
+        "backend": "cpu-gloo-simulated",
+        "entries": entries,
+        "all_tree_hashes_equal": len(hashes) == 1,
+        "collective_bytes_per_level": _collective_table(),
+    }
+    out_path = out_path or os.path.join(
+        os.path.dirname(os.path.dirname(os.path.abspath(__file__))),
+        "MULTIHOST_BENCH.json")
+    from lightgbm_tpu.utils.atomic_io import atomic_write_text
+    atomic_write_text(out_path, json.dumps(result, indent=1) + "\n")
+    print(f"# wrote {out_path}", file=sys.stderr)
+    return result
+
+
+if __name__ == "__main__":
+    if len(sys.argv) > 1 and sys.argv[1] == "--worker":
+        worker(int(sys.argv[2]), int(sys.argv[3]), int(sys.argv[4]),
+               sys.argv[5], int(sys.argv[6]))
+    else:
+        res = run(sys.argv[1] if len(sys.argv) > 1 else None)
+        assert res["all_tree_hashes_equal"], \
+            "tree hashes diverged across host counts"
+        print(json.dumps({k: res[k] for k in
+                          ("entries", "all_tree_hashes_equal")}, indent=1))

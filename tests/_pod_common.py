@@ -1,0 +1,94 @@
+"""Shared fixtures for the pod (multi-host) drill: dataset, params, digests.
+
+Imported by BOTH the pytest parent (tests/test_zz_pod_drill.py) and the
+spawned rank workers (tests/_pod_worker.py), so the data, the training
+configuration and the hashing are identical by construction on every side of
+the comparison.
+"""
+import hashlib
+
+import numpy as np
+
+# drill geometry: 8 features (divisible by feature_shards=2, none trivial),
+# small enough that a 4-process CPU/gloo run finishes well inside tier-1
+N_ROWS = 3000
+N_FEATURES = 8
+ROUNDS = 4
+GRIDS = {
+    # mode -> (num_shards, feature_shards, extra params)
+    "dp": (8, 1, {}),
+    "voting": (8, 1, {"voting_parallel": 1, "top_k": 3}),
+    "dp2d": (4, 2, {}),
+    "chaos": (4, 1, {}),
+}
+
+
+def make_data(seed: int = 17):
+    """Deterministic dense matrix with numeric + repeated-value columns and
+    some NaNs — enough structure to exercise every sketch path."""
+    rng = np.random.RandomState(seed)
+    X = rng.randn(N_ROWS, N_FEATURES).astype(np.float64)
+    X[:, 2] = np.round(X[:, 2] * 4) / 4          # heavy ties
+    X[:, 3] = rng.randint(0, 6, N_ROWS)          # few distinct values
+    X[rng.rand(N_ROWS) < 0.05, 4] = np.nan       # missing
+    X[rng.rand(N_ROWS) < 0.4, 5] = 0.0           # sparse zeros
+    w = rng.randn(N_FEATURES)
+    logits = (np.nan_to_num(X) @ w) / 2.0
+    y = (logits + rng.randn(N_ROWS) * 0.5 > 0).astype(np.float64)
+    return X, y
+
+
+def base_params(mode: str):
+    ns, fs, extra = GRIDS[mode]
+    p = {
+        "objective": "binary",
+        "num_leaves": 7,
+        "max_bin": 16,
+        "min_data_in_leaf": 5,
+        "learning_rate": 0.5,
+        "bagging_fraction": 1.0,
+        "feature_fraction": 1.0,
+        "enable_bundle": False,
+        "grow_policy": "depthwise",
+        "verbosity": -1,
+        "num_shards": ns,
+        "feature_shards": fs,
+        "boost_from_average": False,
+    }
+    p.update(extra)
+    return p
+
+
+def lattice_fobj(preds, train_data):
+    """Logistic-loss custom objective with LATTICE-ROUNDED gradients: grads
+    are exact multiples of 2^-9 and hessians a constant 0.25, so every f32
+    histogram partial sum is exact — any psum association (serial, local
+    mesh, cross-host gloo ring) yields bit-identical histograms, making the
+    byte-identity drill assert exact equality instead of tolerances."""
+    y = np.asarray(train_data.get_label(), np.float64)
+    p = 1.0 / (1.0 + np.exp(-np.asarray(preds, np.float64)))
+    g = np.round((p - y) * 512.0) / 512.0
+    h = np.full_like(g, 0.25)
+    return g.astype(np.float32), h.astype(np.float32)
+
+
+def mapper_digest(mappers) -> str:
+    hsh = hashlib.sha256()
+    for m in mappers:
+        hsh.update(np.asarray([m.bin_type, m.missing_type, m.num_bins,
+                               m.default_bin, m.most_freq_bin,
+                               int(m.is_trivial)], np.int64).tobytes())
+        hsh.update(np.asarray(m.upper_bounds, np.float64).tobytes())
+        hsh.update(np.asarray(m.cat_values, np.int64).tobytes())
+        hsh.update(np.float64(m.sparse_rate).tobytes())
+        hsh.update(np.float64(m.min_value).tobytes())
+        hsh.update(np.float64(m.max_value).tobytes())
+    return hsh.hexdigest()
+
+
+def tree_digest(model_text: str) -> str:
+    """Hash of the model text BEFORE the parameters footer — the trees,
+    feature metadata and leaf values; the footer differs by construction
+    (num_machines, machines, num_shards are per-topology)."""
+    section = model_text.split("\nparameters:\n", 1)[0]
+    return hashlib.sha256(section.encode()).hexdigest()

@@ -1,0 +1,103 @@
+"""Worker for test_zz_pod_drill.py — one rank of an N-process pod drill.
+
+argv: port nranks ndev_per_rank mode datadir [rounds]
+
+With nranks == 1 the same script doubles as the single-host reference run:
+no jax.distributed bootstrap, same shard grid (ndev_per_rank virtual CPU
+devices), same data, same params — so the parent test compares pod digests
+against a single-host run over the IDENTICAL SPMD grid.
+
+Modes (tests/_pod_common.GRIDS): dp (plain data-parallel), voting
+(voting-parallel top-k), dp2d (2-D data x feature mesh), chaos
+(snapshot-every-2 then die at iteration 4 when CHAOS_DIE=1), chaos-resume
+(single-process resume from the chaos snapshots).
+"""
+import os
+import sys
+
+port, nranks, ndev, mode, datadir = sys.argv[1:6]
+nranks, ndev = int(nranks), int(ndev)
+os.environ["XLA_FLAGS"] = f"--xla_force_host_platform_device_count={ndev}"
+os.environ.setdefault("JAX_PLATFORMS", "cpu")
+
+import jax  # noqa: E402
+
+jax.config.update("jax_platforms", "cpu")
+if nranks > 1:
+    jax.config.update("jax_cpu_collectives_implementation", "gloo")
+
+sys.path.insert(0, "/root/repo")
+sys.path.insert(0, os.path.dirname(os.path.abspath(__file__)))
+
+import numpy as np  # noqa: E402
+
+import lightgbm_tpu as lgb  # noqa: E402
+from lightgbm_tpu.parallel import multihost  # noqa: E402
+from lightgbm_tpu.parallel.mesh import (init_distributed,  # noqa: E402
+                                        plan_row_sharding)
+from _pod_common import (GRIDS, ROUNDS, base_params, lattice_fobj,  # noqa: E402
+                         mapper_digest, tree_digest)
+
+
+def main():
+    resume = mode == "chaos-resume"
+    grid_mode = "chaos" if mode.startswith("chaos") else mode
+    ns, fs, _extra = GRIDS[grid_mode]
+    params = base_params(grid_mode)
+    if grid_mode == "chaos":
+        # chaos + chaos-resume share the snapshot dir; the clean reference
+        # run writes nowhere so it cannot pollute the resume source
+        params["snapshot_freq"] = 0 if mode == "chaos-clean" else 2
+        params["snapshot_dir"] = os.path.join(datadir, "snaps")
+    if nranks > 1:
+        from lightgbm_tpu.config import params_to_config
+        params["num_machines"] = nranks
+        params["machines"] = ",".join(
+            [f"127.0.0.1:{port}"] + ["127.0.0.1:0"] * (nranks - 1))
+        init_distributed(params_to_config(params))
+        assert jax.process_count() == nranks, jax.process_count()
+    rank = jax.process_index()
+
+    # ---- per-host file-shard ingest: read ONLY this host's row range ----
+    xpath = os.path.join(datadir, "X.npy")
+    ypath = os.path.join(datadir, "y.npy")
+    n_global = int(np.load(xpath, mmap_mode="r").shape[0])
+    plan = plan_row_sharding(n_global, ns, feature_shards=fs)
+    assert plan is not None
+    row0, row1 = multihost.host_row_range(plan)
+    Xl = multihost.load_file_shard(xpath, row0, row1)
+    yl = multihost.load_file_shard(ypath, row0, row1)
+
+    dtrain = lgb.Dataset(Xl, label=yl, params=params)
+    callbacks = None
+    if mode == "chaos" and nranks > 1:
+        def _die(env):
+            if env.iteration == 4:
+                # simulate a host loss mid-train: snapshots for iterations
+                # 2 and 4 are on disk, iteration 5 never happens
+                sys.stdout.flush()
+                os._exit(17)
+        callbacks = [_die]
+    booster = lgb.train(params, dtrain,
+                        num_boost_round=(6 if grid_mode == "chaos"
+                                         else ROUNDS),
+                        fobj=lattice_fobj, verbose_eval=False,
+                        callbacks=callbacks,
+                        resume_from_snapshot=(params["snapshot_dir"]
+                                              if resume else None))
+
+    md = mapper_digest(dtrain.mappers)
+    td = tree_digest(booster.model_to_string())
+    if nranks > 1:
+        # digests must agree across ranks before the parent even looks
+        from jax.experimental import multihost_utils
+        import hashlib
+        both = np.frombuffer(
+            hashlib.sha256((md + td).encode()).digest()[:16], np.uint32)
+        allv = np.asarray(multihost_utils.process_allgather(both))
+        assert np.all(allv == allv[0]), f"ranks diverge: {allv}"
+    print(f"POD_OK rank={rank} mode={mode} mappers={md} tree={td}")
+
+
+if __name__ == "__main__":
+    main()

@@ -79,7 +79,10 @@ def test_rule_reports_diff_without_probe(monkeypatch):
 PROBE_ENTRIES = {"dataset_construct", "train_3_iters", "predict_cold",
                  "predict_warm_repeat", "train_3_iters_lossguide",
                  "train_warm_extra2_dart", "train_warm_extra2_goss",
-                 "train_warm_extra2_rf", "predict_engine_warm"}
+                 "train_warm_extra2_rf", "predict_engine_warm",
+                 # pod surface (the --multihost probe pass)
+                 "train_3_iters_pod2d", "train_warm_extra2_pod2d",
+                 "train_3_iters_voting", "train_warm_extra2_voting"}
 
 
 def test_committed_budget_matches_probe_entry_names():
@@ -95,7 +98,8 @@ def test_warmed_entries_budgeted_at_zero():
     committed = cb.load_budget()
     for name in ("predict_warm_repeat", "train_warm_extra2_dart",
                  "train_warm_extra2_goss", "train_warm_extra2_rf",
-                 "predict_engine_warm"):
+                 "predict_engine_warm", "train_warm_extra2_pod2d",
+                 "train_warm_extra2_voting"):
         assert committed.get(name) == 0, name
 
 

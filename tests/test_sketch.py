@@ -1,0 +1,150 @@
+"""Property tests for the mergeable bin-finding sketch (binning.FeatureSketch)
+and its multi-host wire codec (parallel/multihost.py).
+
+The pod's global-bins guarantee rests on three algebraic facts, each pinned
+here directly instead of only end-to-end:
+
+1. merge is ORDER-INVARIANT: any permutation of the per-host sketches merges
+   to the identical sketch (hosts merge in rank order, but nothing may depend
+   on it);
+2. merge is ASSOCIATIVE: any reduction tree equals the flat merge — so a
+   future hierarchical (rack-level) merge cannot change the bins;
+3. ``BinMapper.from_sketch`` over the merge is BIT-IDENTICAL to single-host
+   ``find_bin_mappers`` over the concatenated rows — sketching loses nothing.
+"""
+import numpy as np
+import pytest
+
+from lightgbm_tpu.binning import (BIN_CATEGORICAL, BIN_NUMERICAL, BinMapper,
+                                  FeatureSketch, find_bin_mappers,
+                                  merge_sketches, sketch_feature)
+from lightgbm_tpu.parallel.multihost import decode_sketches, encode_sketches
+
+
+def _rand_column(rng, n, kind):
+    if kind == "dense":
+        return rng.randn(n)
+    if kind == "ties":
+        return np.round(rng.randn(n) * 4) / 4
+    if kind == "few":
+        return rng.randint(0, 5, n).astype(np.float64)
+    if kind == "nan":
+        v = rng.randn(n)
+        v[rng.rand(n) < 0.1] = np.nan
+        return v
+    if kind == "zeros":
+        v = rng.randn(n)
+        v[rng.rand(n) < 0.5] = 0.0
+        return v
+    raise AssertionError(kind)
+
+
+def _sketch_equal(a: FeatureSketch, b: FeatureSketch) -> bool:
+    return (a.bin_type == b.bin_type
+            and np.array_equal(a.distinct, b.distinct)
+            and np.array_equal(a.counts, b.counts)
+            and a.zero_cnt == b.zero_cnt and a.na_cnt == b.na_cnt
+            and a.total_cnt == b.total_cnt)
+
+
+def _split_sketches(values, cuts, bin_type=BIN_NUMERICAL):
+    parts = np.split(values, cuts)
+    return [sketch_feature(p, len(p), bin_type) for p in parts]
+
+
+@pytest.mark.parametrize("kind", ["dense", "ties", "few", "nan", "zeros"])
+def test_merge_order_invariant(kind):
+    rng = np.random.RandomState(3)
+    for trial in range(20):
+        n = rng.randint(50, 400)
+        v = _rand_column(rng, n, kind)
+        nparts = rng.randint(2, 6)
+        cuts = np.sort(rng.choice(n, nparts - 1, replace=False))
+        parts = _split_sketches(v, cuts)
+        ref = merge_sketches(parts)
+        for _ in range(5):
+            perm = rng.permutation(len(parts))
+            assert _sketch_equal(ref, merge_sketches([parts[i]
+                                                      for i in perm]))
+
+
+def test_merge_associative():
+    rng = np.random.RandomState(5)
+    for trial in range(20):
+        n = rng.randint(60, 300)
+        v = _rand_column(rng, n, "ties")
+        a, b, c = _split_sketches(v, np.sort(rng.choice(n, 2, replace=False)))
+        left = merge_sketches([merge_sketches([a, b]), c])
+        right = merge_sketches([a, merge_sketches([b, c])])
+        flat = merge_sketches([a, b, c])
+        assert _sketch_equal(left, right)
+        assert _sketch_equal(left, flat)
+
+
+def test_merge_equals_sketch_of_concat():
+    rng = np.random.RandomState(7)
+    for kind in ("dense", "ties", "few", "nan", "zeros"):
+        for trial in range(10):
+            n = rng.randint(50, 300)
+            v = _rand_column(rng, n, kind)
+            cuts = np.sort(rng.choice(n, rng.randint(1, 4), replace=False))
+            merged = merge_sketches(_split_sketches(v, cuts))
+            assert _sketch_equal(merged, sketch_feature(v, n, BIN_NUMERICAL))
+
+
+def test_categorical_merge_and_mapper():
+    rng = np.random.RandomState(11)
+    v = rng.randint(0, 12, 500).astype(np.float64)
+    v[rng.rand(500) < 0.05] = np.nan
+    parts = np.split(v, [137, 260, 401])
+    merged = merge_sketches(
+        [sketch_feature(p, len(p), BIN_CATEGORICAL) for p in parts])
+    assert _sketch_equal(merged, sketch_feature(v, 500, BIN_CATEGORICAL))
+    a = BinMapper.from_sketch(merged, 16, min_data_in_bin=3)
+    b = find_bin_mappers(v.reshape(-1, 1), max_bin=16, categorical=[0])[0]
+    assert np.array_equal(np.asarray(a.cat_values), np.asarray(b.cat_values))
+    assert a.num_bins == b.num_bins and a.bin_type == b.bin_type
+
+
+def test_from_sketch_bit_identical_to_find_bins_on_concat():
+    """The tentpole claim: merged-sketch bins over row splits == single-host
+    find_bin_mappers over the full matrix, for every mapper field, with no
+    sampling in play (n below the sample threshold)."""
+    rng = np.random.RandomState(13)
+    n, f = 900, 5
+    X = np.stack([_rand_column(rng, n, k) for k in
+                  ("dense", "ties", "few", "nan", "zeros")], axis=1)
+    ref = find_bin_mappers(X, max_bin=16)
+    for cuts in ([300, 600], [1, 899], [450], [123, 456, 789]):
+        rows = np.split(np.arange(n), cuts)
+        for j in range(f):
+            merged = merge_sketches(
+                [sketch_feature(X[r, j], len(r), BIN_NUMERICAL)
+                 for r in rows])
+            m = BinMapper.from_sketch(merged, 16, min_data_in_bin=3)
+            r = ref[j]
+            assert m.num_bins == r.num_bins
+            assert m.bin_type == r.bin_type
+            assert m.missing_type == r.missing_type
+            assert m.most_freq_bin == r.most_freq_bin
+            assert m.default_bin == r.default_bin
+            assert m.is_trivial == r.is_trivial
+            assert m.sparse_rate == r.sparse_rate
+            ub_m = np.asarray(m.upper_bounds, np.float64)
+            ub_r = np.asarray(r.upper_bounds, np.float64)
+            assert ub_m.tobytes() == ub_r.tobytes()   # NaN-safe exact bytes
+
+
+def test_wire_codec_roundtrip_exact():
+    rng = np.random.RandomState(17)
+    kinds = ("dense", "ties", "few", "nan", "zeros")
+    sketches = [sketch_feature(_rand_column(rng, 333, k), 333,
+                               BIN_NUMERICAL) for k in kinds]
+    sketches.append(sketch_feature(
+        rng.randint(0, 9, 333).astype(np.float64), 333, BIN_CATEGORICAL))
+    back = decode_sketches(encode_sketches(sketches), len(sketches))
+    for a, b in zip(sketches, back):
+        assert _sketch_equal(a, b)
+    # empty sketch (a host that owns only padding rows) survives the wire
+    empty = decode_sketches(encode_sketches([FeatureSketch()]), 1)[0]
+    assert _sketch_equal(empty, FeatureSketch())

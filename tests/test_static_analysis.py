@@ -1841,3 +1841,141 @@ def test_group_sweep_loop_suppressed_and_clean():
     # the shipped idiom — wait on the stop event, bounded — is clean
     assert "host-sync-in-jit" not in names(
         analyze_source(SWEEP_LOOP_CLEAN, relpath=ONLINE_REL))
+
+
+# ---- pod multihost module scopes (PR: pod-scale multi-host training) ----
+# lightgbm_tpu/parallel/multihost.py hosts the cross-process bin-sync and
+# row-exchange collectives; it joins the unlocked-shared-state scope (its
+# collectives run while ingest commit threads are live), stays inside the
+# repo-wide swallowed-device-error scope, and its 2-D mesh work makes the
+# "feature" axis a declared mesh axis. Fire / suppressed / clean per rule.
+
+MULTIHOST_REL = "lightgbm_tpu/parallel/multihost.py"
+
+MH_SHARED_BAD = """
+_MERGED = {}
+
+def cache_sketches(key, sketches):
+    _MERGED[key] = sketches
+"""
+
+MH_SHARED_SUPPRESSED = """
+_MERGED = {}
+
+def cache_sketches(key, sketches):
+    # single writer: bin finding runs before any worker thread starts
+    _MERGED[key] = sketches   # tpu-lint: disable=unlocked-shared-state
+"""
+
+MH_SHARED_LOCKED = """
+import threading
+
+_MERGED = {}
+_lock = threading.Lock()
+
+def cache_sketches(key, sketches):
+    with _lock:
+        _MERGED[key] = sketches
+"""
+
+
+def test_multihost_module_in_shared_state_scope():
+    assert "unlocked-shared-state" in names(
+        analyze_source(MH_SHARED_BAD, relpath=MULTIHOST_REL))
+    assert "unlocked-shared-state" not in names(
+        analyze_source(MH_SHARED_SUPPRESSED, relpath=MULTIHOST_REL))
+    kept = analyze_source(MH_SHARED_SUPPRESSED, relpath=MULTIHOST_REL,
+                          keep_suppressed=True)
+    assert "unlocked-shared-state" in names(kept)
+    assert "unlocked-shared-state" not in names(
+        analyze_source(MH_SHARED_LOCKED, relpath=MULTIHOST_REL))
+    # the same mutation in a module outside every designated scope is the
+    # normal single-threaded idiom
+    assert "unlocked-shared-state" not in names(
+        analyze_source(MH_SHARED_BAD, relpath="lightgbm_tpu/engine.py"))
+
+
+MH_FEATURE_AXIS_FIRE = """
+import jax
+
+def gather_blocks(sub):
+    return jax.lax.all_gather(sub, "featur", axis=2, tiled=True)
+"""
+
+MH_FEATURE_AXIS_SUPPRESSED = """
+import jax
+
+def gather_blocks(sub):
+    return jax.lax.all_gather(sub, "featur", axis=2, tiled=True)  # tpu-lint: disable=collective-consistency
+"""
+
+MH_FEATURE_AXIS_CLEAN = """
+import jax
+
+def gather_blocks(sub, hist):
+    j = jax.lax.axis_index("feature")
+    total = jax.lax.psum(hist, axis_name="data")
+    return j, jax.lax.all_gather(sub, "feature", axis=2, tiled=True)
+"""
+
+
+def test_collective_consistency_recognizes_feature_axis():
+    """FEATURE_AXIS = "feature" in parallel/mesh.py makes the 2-D mesh axis
+    a declared axis: typos fire, the real axis (and "data") stay clean."""
+    from lightgbm_tpu.analysis.facts import mesh_axes
+    assert {"data", "feature"} <= mesh_axes()
+    fs = analyze_source(MH_FEATURE_AXIS_FIRE, relpath=MULTIHOST_REL,
+                        rules=["collective-consistency"])
+    assert names(fs) == ["collective-consistency"]
+    assert "'featur'" in fs[0].message and "feature" in fs[0].message
+    assert "collective-consistency" not in names(
+        analyze_source(MH_FEATURE_AXIS_SUPPRESSED, relpath=MULTIHOST_REL,
+                       rules=["collective-consistency"]))
+    assert "collective-consistency" not in names(
+        analyze_source(MH_FEATURE_AXIS_CLEAN, relpath=MULTIHOST_REL,
+                       rules=["collective-consistency"]))
+
+
+MH_SWALLOWED_BAD = """
+import jax
+
+def replicate(x, mesh):
+    try:
+        out = jax.device_put(x, mesh.devices.flat[0])
+        out.block_until_ready()
+        return out
+    except Exception as e:
+        log.debug("replicate failed: %s", e)
+"""
+
+MH_SWALLOWED_SUPPRESSED = """
+import jax
+
+def probe_remote(x, dev):
+    try:
+        jax.device_put(x, dev).block_until_ready()
+    except Exception as e:   # tpu-lint: disable=swallowed-device-error
+        return None
+"""
+
+MH_SWALLOWED_CLEAN = """
+import jax
+from ..utils.retry import call_with_backoff
+
+def replicate(x, dev):
+    return call_with_backoff(lambda: jax.device_put(x, dev),
+                             name="pod replicate")
+"""
+
+
+def test_multihost_module_in_swallowed_device_error_scope():
+    assert "swallowed-device-error" in names(
+        analyze_source(MH_SWALLOWED_BAD, relpath=MULTIHOST_REL))
+    assert "swallowed-device-error" not in names(
+        analyze_source(MH_SWALLOWED_SUPPRESSED, relpath=MULTIHOST_REL))
+    kept = analyze_source(MH_SWALLOWED_SUPPRESSED, relpath=MULTIHOST_REL,
+                          keep_suppressed=True)
+    assert "swallowed-device-error" in names(kept)
+    # the module's actual idiom — collectives behind call_with_backoff
+    assert "swallowed-device-error" not in names(
+        analyze_source(MH_SWALLOWED_CLEAN, relpath=MULTIHOST_REL))

@@ -1,0 +1,146 @@
+"""Pod drill: multi-host (multi-process) training must be byte-identical to
+a single-process run over the SAME shard grid.
+
+Each drill spawns N rank subprocesses (tests/_pod_worker.py) that bootstrap
+``jax.distributed`` with gloo CPU collectives, ingest ONLY their own file
+shard (parallel/multihost.host_row_range + load_file_shard), and train with
+the lattice-rounded objective (tests/_pod_common.lattice_fobj) whose f32
+histogram partial sums are exact — so "byte-identical" is assertable as
+string equality of digests, with no tolerance anywhere:
+
+- bin mappers: merged-sketch global bins == single-host find_bin_mappers
+  over the concatenated rows (not merely rank-consistent);
+- model text (tree section): pod run == single-process run with the same
+  ``--xla_force_host_platform_device_count`` grid, i.e. the same SPMD
+  program — host-count independence, which is the property a pod needs.
+
+The chaos drill kills every rank mid-train (os._exit at iteration 4),
+resumes from the rank-0 snapshots at a DIFFERENT host count (2 -> 1, shard
+grid unchanged: PR 13's unsharded snapshot state), and must reproduce the
+uninterrupted model byte-for-byte.
+"""
+import os
+import subprocess
+import sys
+
+import numpy as np
+import pytest
+
+sys.path.insert(0, os.path.dirname(os.path.abspath(__file__)))
+from _mp_util import spawn_ranks  # noqa: E402
+from _pod_common import GRIDS, make_data  # noqa: E402
+
+_HERE = os.path.dirname(os.path.abspath(__file__))
+WORKER = os.path.join(_HERE, "_pod_worker.py")
+
+
+def _parse_pod_ok(text: str):
+    for line in text.splitlines():
+        if line.startswith("POD_OK"):
+            parts = dict(p.split("=", 1) for p in line.split()[1:])
+            return parts["mappers"], parts["tree"]
+    raise AssertionError("no POD_OK line in worker output:\n" + text[-3000:])
+
+
+def _run_single(mode: str, ndev: int, datadir: str, timeout: int = 420):
+    """Single-process worker run (reference / resume legs): needs its own
+    virtual-device count, which must be set before jax imports -> subprocess,
+    with the parent pytest XLA_FLAGS stripped."""
+    env = {k: v for k, v in os.environ.items() if k != "XLA_FLAGS"}
+    env["JAX_PLATFORMS"] = "cpu"
+    out = subprocess.run(
+        [sys.executable, WORKER, "0", "1", str(ndev), mode, datadir],
+        capture_output=True, text=True, timeout=timeout, env=env,
+        cwd="/root/repo")
+    assert out.returncode == 0, (out.stdout[-3000:] + out.stderr[-3000:])
+    return _parse_pod_ok(out.stdout)
+
+
+def _run_pod(mode: str, nranks: int, ndev: int, datadir: str,
+             expect_rc: int = 0, timeout: int = 420):
+    def worker_args(port):
+        return [os.path.relpath(WORKER, "/root/repo"), str(port),
+                str(nranks), str(ndev), mode, datadir]
+    procs, outs = spawn_ranks(worker_args, nprocs=nranks, timeout=timeout)
+    for p, o in zip(procs, outs):
+        assert p.returncode == expect_rc, \
+            f"rank rc={p.returncode} (expected {expect_rc}):\n{o[-3000:]}"
+    if expect_rc != 0:
+        return None
+    digests = [_parse_pod_ok(o) for o in outs]
+    assert all(d == digests[0] for d in digests), digests
+    return digests[0]
+
+
+@pytest.fixture(scope="module")
+def pod_data(tmp_path_factory):
+    d = tmp_path_factory.mktemp("poddata")
+    X, y = make_data()
+    np.save(os.path.join(str(d), "X.npy"), X)
+    np.save(os.path.join(str(d), "y.npy"), y)
+    return str(d)
+
+
+@pytest.fixture(scope="module")
+def serial_mapper_digest(pod_data):
+    """Plain single-chip find_bin_mappers digest over the FULL matrix — the
+    bins the pod must reproduce exactly (grid-independent ground truth)."""
+    from _pod_common import base_params, mapper_digest
+    from lightgbm_tpu.binning import find_bin_mappers
+    X = np.load(os.path.join(pod_data, "X.npy"))
+    p = base_params("dp")
+    return mapper_digest(find_bin_mappers(X, max_bin=p["max_bin"]))
+
+
+@pytest.mark.parametrize("mode,nranks,ndev", [
+    ("dp", 4, 2),        # the acceptance drill: 4 hosts x 2 devices
+    ("voting", 2, 4),    # voting-parallel top-k over the same 8-shard grid
+])
+def test_pod_byte_identical_to_single_host(mode, nranks, ndev, pod_data,
+                                           serial_mapper_digest):
+    pod = _run_pod(mode, nranks, ndev, pod_data)
+    ref = _run_single(mode, nranks * ndev, pod_data)
+    assert pod == ref, f"pod {pod} != single-host {ref}"
+    assert pod[0] == serial_mapper_digest, \
+        "merged-sketch bins differ from serial find_bin_mappers"
+
+
+@pytest.mark.slow
+def test_pod_2d_mesh_byte_identical(pod_data):
+    """2 hosts x 4 devices on the ("data","feature") mesh: the sliced
+    histogram allreduce must not change a single byte vs the same grid in
+    one process."""
+    pod = _run_pod("dp2d", 2, 4, pod_data)
+    ref = _run_single("dp2d", 8, pod_data)
+    assert pod == ref
+
+
+def test_chaos_kill_and_resume_across_host_counts(pod_data):
+    """Kill BOTH ranks at iteration 4, resume on ONE process (same 4-shard
+    grid) from the rank-0 snapshots, and match the uninterrupted run."""
+    _run_pod("chaos", 2, 2, pod_data, expect_rc=17)
+    snapdir = os.path.join(pod_data, "snaps")
+    assert os.path.exists(os.path.join(snapdir, "snapshot_iter_4.txt"))
+    resumed = _run_single("chaos-resume", 4, pod_data)
+    clean = _run_single("chaos-clean", 4, pod_data)
+    assert resumed == clean, \
+        f"resumed {resumed} != uninterrupted {clean}"
+
+
+def test_2d_mesh_matches_1d_in_process():
+    """In-process (8 virtual devices): ns=4 x fs=2 must equal ns=4 x fs=1 —
+    the dynamic-slice + psum + tiled all_gather path is exactly the plain
+    psum, reassembled."""
+    import lightgbm_tpu as lgb
+    from _pod_common import base_params, lattice_fobj, tree_digest, make_data
+
+    X, y = make_data(seed=23)
+    digests = []
+    for fs in (1, 2):
+        p = base_params("dp")
+        p.update(num_shards=4, feature_shards=fs)
+        dtrain = lgb.Dataset(X, label=y, params=p)
+        booster = lgb.train(p, dtrain, num_boost_round=3, fobj=lattice_fobj,
+                            verbose_eval=False)
+        digests.append(tree_digest(booster.model_to_string()))
+    assert digests[0] == digests[1]
