@@ -1,0 +1,231 @@
+"""collectivewatch: runtime per-rank collective ledger.
+
+The static pod-safety rules (``collective-divergence``, ``collective-order``,
+``wire-dtype``) reason about the composed call graph; this module validates
+that reasoning against REALITY by recording the sequence of host-level
+collectives each rank actually issues while the pod drills run. Two ranks
+whose ledgers disagree — different op order, different payload dtype or
+shape at the same position — have already paired mismatched rendezvous; on
+a real pod that is a hang or silent corruption, here it fails the drill
+with both ledgers in the error.
+
+Mechanics: :func:`install` patches the DCN-level collective entry points in
+``jax.experimental.multihost_utils`` (``process_allgather``,
+``broadcast_one_to_all``, ``sync_global_devices``) with thin wrappers that
+append ``(op, payload dtype, payload shape, call site)`` to a process-global
+ledger (:data:`WATCH`) before delegating. Device collectives inside jitted
+code (psum/all_gather) are NOT patched: they are traced once, not executed
+per call, so a runtime wrapper would record compilation order, not execution
+order — the static rules own that layer.
+
+The ledger also enforces the wire-codec discipline at runtime: a HOST
+(numpy) payload reaching a raw collective with any dtype other than
+uint8/int32 is exactly the PR 22 silent-f64-downcast class
+(``jax_enable_x64=False`` rounds it through f32 mid-flight), and is
+reported by :meth:`CollectiveWatch.wire_violations` even when every rank
+agrees. Device-array payloads are exempt — they already carry the device
+dtype, so there is nothing left to drift.
+
+Bootstrap: the pod drill workers call :func:`install` right after
+``jax.distributed`` init with a per-rank ledger path
+(``LGBMTPU_COLLWATCH_LEDGER``); the drill harness compares the written
+ledgers at teardown via :func:`assert_ledgers_match`. ``tests/conftest.py``
+installs it ledger-less for single-process runs so unit tests can inspect
+:data:`WATCH` directly. ``LGBMTPU_COLLWATCH=0`` disables installation
+entirely. Stdlib-only on purpose — jax is touched only inside
+:func:`install`, after the caller has already imported it.
+"""
+from __future__ import annotations
+
+import json
+import os
+import sys
+from typing import Any, Dict, List, Optional, Sequence, Tuple
+
+# dtypes the wire codec is allowed to put on a raw collective: payload bytes
+# and the int32 width/meta negotiation (see parallel/multihost.py)
+HOST_WIRE_DTYPES = ("uint8", "int32")
+
+_OPS = ("process_allgather", "broadcast_one_to_all", "sync_global_devices")
+
+
+def _caller_site() -> str:
+    """Nearest stack frame outside this module — the collective call site."""
+    f = sys._getframe(1)
+    while f is not None and f.f_code.co_filename == __file__:
+        f = f.f_back
+    if f is None:
+        return "<unknown>"
+    return f"{os.path.basename(f.f_code.co_filename)}:{f.f_lineno}"
+
+
+def _describe(payload: Any) -> Tuple[str, Tuple[int, ...], bool]:
+    """(dtype, shape, is_host_payload) for a collective argument. Host means
+    a numpy array — the case where x64-disabled jax silently recasts the
+    payload; device arrays already carry the device dtype."""
+    dt = getattr(payload, "dtype", None)
+    shape = getattr(payload, "shape", None)
+    if dt is None or shape is None:
+        return type(payload).__name__, (), False
+    host = type(payload).__module__.split(".")[0] == "numpy"
+    return str(dt), tuple(int(s) for s in shape), host
+
+
+class CollectiveWatch:
+    """Process-global recorder. One instance (:data:`WATCH`) lives for the
+    process; unit tests build private instances."""
+
+    def __init__(self, ledger_path: Optional[str] = None) -> None:
+        self.records: List[Dict[str, Any]] = []
+        self.enabled = True
+        self.ledger_path = ledger_path
+
+    # -- recording ---------------------------------------------------------
+    def note(self, op: str, payload: Any) -> None:
+        if not self.enabled:
+            return
+        dtype, shape, host = _describe(payload)
+        self.records.append({"op": op, "dtype": dtype,
+                             "shape": list(shape), "host": host,
+                             "site": _caller_site()})
+
+    # -- reporting ---------------------------------------------------------
+    def sequence(self) -> List[Tuple[str, str, Tuple[int, ...]]]:
+        """The rank's rendezvous identity: ordered (op, dtype, shape)."""
+        return [(r["op"], r["dtype"], tuple(r["shape"]))
+                for r in self.records]
+
+    def wire_violations(self) -> List[str]:
+        """Host payloads that crossed a raw collective outside the uint8
+        codec — the silent-downcast class the wire-dtype rule guards."""
+        return [
+            f"{r['op']}({r['dtype']}{tuple(r['shape'])}) at {r['site']}: "
+            f"host payload bypassed the uint8 wire codec — with x64 "
+            f"disabled this dtype recasts silently in flight"
+            for r in self.records
+            if r["host"] and r["dtype"] not in HOST_WIRE_DTYPES]
+
+    def assert_clean(self, context: str = "") -> None:
+        bad = self.wire_violations()
+        if bad:
+            where = f" during {context}" if context else ""
+            raise AssertionError(
+                f"collectivewatch recorded {len(bad)} wire-dtype "
+                f"violation(s){where}:\n" + "\n".join(bad))
+
+    def write_ledger(self, path: Optional[str] = None) -> Optional[str]:
+        path = path or self.ledger_path
+        if not path:
+            return None
+        # transient per-drill artifact in the test tmpdir, re-written whole
+        # each run; atomicity buys nothing  # tpu-lint: disable=non-atomic-artifact-write
+        with open(path, "w") as fh:
+            for r in self.records:
+                fh.write(json.dumps(r) + "\n")
+        return path
+
+    def reset(self) -> None:
+        self.records.clear()
+
+
+WATCH = CollectiveWatch()
+
+
+# ---------------------------------------------------------------------------
+# cross-rank ledger comparison (runs in the drill harness, not the workers)
+
+
+def read_ledger(path: str) -> List[Dict[str, Any]]:
+    with open(path) as fh:
+        return [json.loads(line) for line in fh if line.strip()]
+
+
+def _key(rec: Dict[str, Any]) -> Tuple[str, str, Tuple[int, ...]]:
+    return (rec["op"], rec["dtype"], tuple(rec["shape"]))
+
+
+def compare_ledgers(paths: Sequence[str]) -> List[str]:
+    """Mismatch report across per-rank ledgers: every rank must have issued
+    the SAME ordered (op, dtype, shape) sequence, plus zero per-rank wire
+    violations. Empty list == consistent pod."""
+    ranks = [read_ledger(p) for p in paths]
+    out: List[str] = []
+    lens = {len(r) for r in ranks}
+    if len(lens) > 1:
+        counts = ", ".join(f"rank{i}={len(r)}" for i, r in enumerate(ranks))
+        out.append(f"collective COUNT diverges across ranks ({counts}): "
+                   "some rank skipped or repeated a rendezvous")
+    for pos in range(min(len(r) for r in ranks) if ranks else 0):
+        keys = [_key(r[pos]) for r in ranks]
+        if len(set(keys)) > 1:
+            shown = "; ".join(
+                f"rank{i}: {k[0]}({k[1]}{k[2]}) at {ranks[i][pos]['site']}"
+                for i, k in enumerate(keys))
+            out.append(f"rendezvous #{pos} diverges — {shown}")
+    for i, recs in enumerate(ranks):
+        w = CollectiveWatch()
+        w.records = recs
+        out.extend(f"rank{i}: {v}" for v in w.wire_violations())
+    return out
+
+
+def assert_ledgers_match(paths: Sequence[str], context: str = "") -> None:
+    problems = compare_ledgers(paths)
+    if problems:
+        where = f" during {context}" if context else ""
+        raise AssertionError(
+            f"collectivewatch: {len(problems)} cross-rank ledger "
+            f"problem(s){where}:\n" + "\n".join(problems))
+
+
+# ---------------------------------------------------------------------------
+# installation
+
+
+_installed = False
+
+
+def _wrap(op: str, fn, watch: "CollectiveWatch"):
+    def wrapped(x, *args, **kwargs):
+        watch.note(op, x)
+        return fn(x, *args, **kwargs)
+    wrapped.__name__ = f"collectivewatch_{op}"
+    wrapped.__wrapped__ = fn
+    return wrapped
+
+
+def install(ledger_path: Optional[str] = None) -> bool:
+    """Patch the multihost_utils collective entry points so every DCN
+    rendezvous this process issues lands in :data:`WATCH`. Idempotent.
+    Returns whether the patch is active (False under LGBMTPU_COLLWATCH=0).
+    Call AFTER jax is importable — the drills install right after
+    ``jax.distributed`` init."""
+    global _installed
+    if os.environ.get("LGBMTPU_COLLWATCH", "1") == "0":
+        return False
+    WATCH.ledger_path = (ledger_path
+                         or os.environ.get("LGBMTPU_COLLWATCH_LEDGER")
+                         or WATCH.ledger_path)
+    if _installed:
+        return True
+    from jax.experimental import multihost_utils
+    for op in _OPS:
+        fn = getattr(multihost_utils, op, None)
+        if fn is None or getattr(fn, "__wrapped__", None) is not None:
+            continue
+        setattr(multihost_utils, op, _wrap(op, fn, WATCH))
+    _installed = True
+    return True
+
+
+def uninstall() -> None:
+    global _installed
+    if not _installed:
+        return
+    from jax.experimental import multihost_utils
+    for op in _OPS:
+        fn = getattr(multihost_utils, op, None)
+        orig = getattr(fn, "__wrapped__", None)
+        if orig is not None:
+            setattr(multihost_utils, op, orig)
+    _installed = False

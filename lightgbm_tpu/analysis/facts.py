@@ -37,6 +37,25 @@ _COLLECTIVES = {"psum", "pmean", "pmax", "pmin", "all_gather", "all_to_all",
                 "ppermute", "psum_scatter", "axis_index"}
 _SHARD_MAP_NAMES = {"shard_map", "shard_map_compat"}
 
+# Cross-process (DCN / host-level) collectives plus the product wrappers
+# that issue them. Every rank MUST enter each of these or the pod hangs:
+# jax primitives first, then the multihost.py/fence.py wrappers the rest of
+# the package is supposed to call.
+PROC_COLLECTIVES = {
+    "process_allgather", "broadcast_one_to_all", "sync_global_devices",
+    "wire_allgather", "allgather_sketches", "allgather_rows",
+    "consistency_fence", "mesh_preflight",
+}
+
+# Device collectives that rendezvous across shards (axis_index is a pure
+# query, not a rendezvous — it cannot deadlock a skipped rank).
+RENDEZVOUS_COLLECTIVES = (_COLLECTIVES - {"axis_index"}) | PROC_COLLECTIVES
+
+# Names whose VALUE differs per rank. A branch conditioned on one of these
+# (directly or through a local assigned from one) partitions the pod: a
+# collective under only some arms is a deadlock-by-skipped-collective.
+RANK_SOURCES = {"process_index", "is_writer_rank", "host_row_range"}
+
 
 @dataclasses.dataclass(frozen=True)
 class LockDef:
@@ -66,6 +85,26 @@ class CallSite:
     receiver: Optional[str] = None
 
 
+@dataclasses.dataclass(frozen=True)
+class BranchArm:
+    """One arm of an ``if``/``elif``/``else`` chain: the ordered callee
+    names lexically inside it (nested compounds included, nested ``def``
+    bodies excluded — they do not run when the arm runs)."""
+    line: int
+    events: Tuple[Tuple[str, int], ...]   # ordered (callee name, line)
+
+
+@dataclasses.dataclass(frozen=True)
+class Branch:
+    """A flattened ``if/elif/else`` chain inside a function body. The
+    implicit empty ``else`` of a chain with no ``orelse`` is materialized as
+    a trailing empty arm so "the other ranks do nothing" is comparable."""
+    line: int
+    rank_dependent: bool
+    markers: Tuple[str, ...]              # RANK_SOURCES seen in the tests
+    arms: Tuple[BranchArm, ...]
+
+
 @dataclasses.dataclass
 class FunctionFacts:
     module: str               # relpath
@@ -73,6 +112,7 @@ class FunctionFacts:
     line: int
     acquires: List[Acquire]
     calls: List[CallSite]
+    branches: List[Branch] = dataclasses.field(default_factory=list)
 
     @property
     def name(self) -> str:
@@ -278,6 +318,7 @@ class _ModuleFactsBuilder(ast.NodeVisitor):
             local_locks: Dict[str, str] = {}
             for child in node.body:
                 self._visit_stmt(child, cls, qual, ff, (), local_locks)
+            _scan_branches(node, ff)
             return
         # other module-level statements: nothing to do
 
@@ -422,6 +463,139 @@ def _axis_literal(call: ast.Call) -> Optional[str]:
     if isinstance(cand, ast.Constant) and isinstance(cand.value, str):
         return cand.value
     return None
+
+
+# ---------------------------------------------------------------------------
+# branch facts: rank-dependent conditions + per-arm call sequences
+
+
+def _calls_under(stmts) -> Tuple[Tuple[str, int], ...]:
+    """Ordered (callee name, line) lexically under ``stmts``, pruning nested
+    ``def``/``class``/lambda bodies (those do not run when the arm runs)."""
+    out: List[Tuple[str, int]] = []
+
+    def rec(node: ast.AST) -> None:
+        for child in ast.iter_child_nodes(node):
+            if isinstance(child, (ast.FunctionDef, ast.AsyncFunctionDef,
+                                  ast.ClassDef, ast.Lambda)):
+                continue
+            if isinstance(child, ast.Call):
+                f = child.func
+                name = f.attr if isinstance(f, ast.Attribute) else \
+                    f.id if isinstance(f, ast.Name) else ""
+                if name:
+                    out.append((name, child.lineno))
+            rec(child)
+
+    for s in stmts:
+        rec(s)
+    out.sort(key=lambda p: p[1])
+    return tuple(out)
+
+
+def _scan_branches(fnode: ast.AST, ff: FunctionFacts) -> None:
+    """Collect every ``if/elif/else`` chain in ``fnode``'s body with (a)
+    whether any condition in the chain is rank-dependent — mentions a
+    ``RANK_SOURCES`` name/attr or a local assigned from one (one-level
+    lexical taint, statements in source order) — and (b) each arm's ordered
+    callee names, for the collective-divergence/-order rules."""
+    tainted: Set[str] = set()
+
+    def markers_of(expr: ast.AST) -> Tuple[Set[str], bool]:
+        marks: Set[str] = set()
+        via_taint = False
+
+        def scan(sub: ast.AST) -> None:
+            nonlocal via_taint
+            if isinstance(sub, (ast.FunctionDef, ast.AsyncFunctionDef,
+                                ast.Lambda)):
+                return
+            if isinstance(sub, ast.Call):
+                f = sub.func
+                callee = f.attr if isinstance(f, ast.Attribute) else \
+                    f.id if isinstance(f, ast.Name) else ""
+                if callee in PROC_COLLECTIVES:
+                    # an allgather's OUTPUT is rank-uniform by construction
+                    # even when its arguments mention process_index — do not
+                    # propagate taint out of the collective
+                    return
+            if isinstance(sub, ast.Name):
+                if sub.id in RANK_SOURCES:
+                    marks.add(sub.id)
+                elif sub.id in tainted:
+                    via_taint = True
+            elif isinstance(sub, ast.Attribute) and sub.attr in RANK_SOURCES:
+                marks.add(sub.attr)
+            for child in ast.iter_child_nodes(sub):
+                scan(child)
+
+        scan(expr)
+        return marks, via_taint
+
+    def taint_assign(stmt: ast.AST) -> None:
+        value = getattr(stmt, "value", None)
+        if value is None:
+            return
+        marks, via = markers_of(value)
+        if not marks and not via:
+            return
+        targets = stmt.targets if isinstance(stmt, ast.Assign) else \
+            [stmt.target] if isinstance(stmt, (ast.AnnAssign, ast.AugAssign)) \
+            else []
+        for t in targets:
+            for sub in walk(t):
+                # only Store-context names become tainted locals: the base
+                # name of an attribute/subscript target (``self`` in
+                # ``self.x = ...``) is a Load and must NOT be poisoned
+                if isinstance(sub, ast.Name) and \
+                        isinstance(sub.ctx, ast.Store):
+                    tainted.add(sub.id)
+                elif isinstance(sub, ast.Starred) and \
+                        isinstance(sub.value, ast.Name):
+                    tainted.add(sub.value.id)
+
+    def visit(stmts) -> None:
+        for s in stmts:
+            if isinstance(s, (ast.FunctionDef, ast.AsyncFunctionDef,
+                              ast.ClassDef)):
+                continue
+            if isinstance(s, (ast.Assign, ast.AnnAssign, ast.AugAssign)):
+                taint_assign(s)
+                continue
+            if isinstance(s, ast.If):
+                tests, arm_bodies, cur = [], [], s
+                while True:
+                    tests.append(cur.test)
+                    arm_bodies.append((cur.lineno, cur.body))
+                    o = cur.orelse
+                    if len(o) == 1 and isinstance(o[0], ast.If):
+                        cur = o[0]
+                        continue
+                    # explicit else, or the implicit empty one
+                    arm_bodies.append((o[0].lineno if o else cur.lineno, o))
+                    break
+                marks: Set[str] = set()
+                dep = False
+                for t in tests:
+                    m, via = markers_of(t)
+                    marks |= m
+                    dep = dep or via
+                ff.branches.append(Branch(
+                    line=s.lineno, rank_dependent=bool(marks) or dep,
+                    markers=tuple(sorted(marks)),
+                    arms=tuple(BranchArm(line=ln, events=_calls_under(body))
+                               for ln, body in arm_bodies)))
+                for _ln, body in arm_bodies:
+                    visit(body)
+                continue
+            for attr in ("body", "orelse", "finalbody"):
+                sub = getattr(s, attr, None)
+                if sub:
+                    visit(sub)
+            for h in getattr(s, "handlers", []) or []:
+                visit(h.body)
+
+    visit(getattr(fnode, "body", []))
 
 
 # ---------------------------------------------------------------------------

@@ -3,5 +3,5 @@
 production incident it guards against (see docs/STATIC_ANALYSIS.md)."""
 from . import (atomic_write, collectives, compile_budget,  # noqa: F401
                device_errors, donation, dtype_drift, host_sync, lock_order,
-               nonfinite, params, retrace, shared_state, telemetry,
-               unsharded_transfer)
+               nonfinite, params, pod_safety, retrace, shared_state,
+               telemetry, unsharded_transfer)

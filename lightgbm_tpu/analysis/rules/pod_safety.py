@@ -1,0 +1,375 @@
+"""SPMD pod-safety rule family (tpu-lint v3).
+
+PR 22's multi-host work produced three bug classes that only surface on a
+real pod, where they hang or silently corrupt instead of erroring:
+
+- a collective reachable under rank-dependent control flow: the ranks that
+  skip the branch never enter the rendezvous and the others wait forever
+  (the ``engine.py`` snapshot hang — non-writer ranks skipped the
+  state-gather collective);
+- two rank-divergent code paths issuing the same collectives in different
+  ORDER: every rank enters a rendezvous, but rank A's psum pairs with rank
+  B's all_gather and the payloads are garbage with no diagnostic;
+- a cross-process payload not routed through the raw-uint8 wire codec in
+  ``parallel/multihost.py``: jax runs with x64 disabled, so
+  ``process_allgather`` silently rounds f64 payloads through f32 (and i64
+  through i32) — found originally by byte-diffing bin mappers across hosts;
+- host materialization (``np.asarray`` / ``device_get``) on an array that
+  may span non-addressable devices: raises ``RuntimeError`` only on a real
+  multi-process pod, never under single-process CI.
+
+The first two compose the pass-1 call graph (``facts.FunctionFacts.calls``
++ per-branch-arm sequences from ``facts.Branch``): a branch arm "reaches" a
+collective if any call in it transitively issues one. Resolution is by bare
+callee name, preferring same-module definitions — the same convention the
+lock-order graph uses.
+"""
+from __future__ import annotations
+
+import ast
+
+from ..astwalk import walk
+from typing import Dict, List, Optional, Set, Tuple
+
+from ..core import ModuleContext, Rule, register
+from ..facts import PROC_COLLECTIVES, RENDEZVOUS_COLLECTIVES
+
+# the ONE blessed raw process_allgather site: the wire codec's gather
+# primitive in parallel/multihost.py; everything else goes through
+# wire_allgather (raw-uint8 payloads) or carries a justified suppression
+_WIRE_MODULE = "lightgbm_tpu/parallel/multihost.py"
+_WIRE_BLESSED_FUNCS = {"_gather_np"}
+_WIRE_CALLS = {"process_allgather", "broadcast_one_to_all"}
+
+# tokens that mark a function as pod-gated (it manipulates process-spanning
+# arrays) and the guards that make host materialization legal there
+_POD_MARKERS = {"process_allgather", "plan_spans_processes",
+                "process_index", "host_row_range"}
+_ADDRESSABILITY_GUARDS = {"is_fully_addressable", "addressable_data",
+                          "addressable_shards", "fully_replicated"}
+_HOST_MATERIALIZERS = {"asarray", "array", "device_get"}
+
+# call-graph depth cap: collective closure memoizes, this only bounds
+# pathological recursion through unresolvable name collisions
+_MAX_DEPTH = 12
+
+
+# ---------------------------------------------------------------------------
+# call-graph collective closure
+
+
+def _function_index(facts) -> Dict[str, List]:
+    """Bare function name -> FunctionFacts (all modules), in deterministic
+    (module, qual) order so name-collision resolution is stable."""
+    idx: Dict[str, List] = {}
+    for ff in sorted(facts.all_functions(),
+                     key=lambda f: (f.module, f.qual)):
+        idx.setdefault(ff.name, []).append(ff)
+    return idx
+
+
+# bare names that are overwhelmingly builtin/container methods: resolving
+# them to a same-named repo function (list.append -> Dataset.append) wires
+# unrelated call chains together and poisons the closure
+_NEVER_RESOLVE = frozenset({
+    "append", "extend", "insert", "pop", "add", "remove", "discard",
+    "get", "items", "keys", "values", "update", "setdefault", "copy",
+    "join", "split", "strip", "format", "encode", "decode", "sum",
+    "write", "read", "flush", "close", "open", "put", "mean", "max",
+    "min", "sort", "index", "count",
+})
+
+
+def _resolve(idx: Dict[str, List], name: str, module: str):
+    """The FunctionFacts a bare call name refers to, preferring a definition
+    in the caller's own module; None when unknown (stdlib/jax/etc.).
+
+    Underscore-private names resolve only within their own module — a
+    ``_callback``-style hook variable in one module must not bind to an
+    unrelated private helper elsewhere."""
+    if name in _NEVER_RESOLVE:
+        return None
+    cands = idx.get(name)
+    if not cands:
+        return None
+    local = [c for c in cands if c.module == module]
+    if local:
+        return local[0]
+    if name.startswith("_"):
+        return None
+    return cands[0]
+
+
+class _Closure:
+    """Memoized flattened collective sequences over the repo call graph."""
+
+    def __init__(self, facts):
+        self.idx = _function_index(facts)
+        self._memo: Dict[Tuple[str, str], Tuple[str, ...]] = {}
+
+    def of_function(self, ff, _depth: int = 0) -> Tuple[str, ...]:
+        key = (ff.module, ff.qual)
+        if key in self._memo:
+            return self._memo[key]
+        if _depth > _MAX_DEPTH:
+            return ()
+        self._memo[key] = ()          # cycle guard: recursion sees ()
+        seq = self.of_events(
+            tuple((c.name, c.line) for c in ff.calls), ff.module,
+            _depth=_depth)
+        self._memo[key] = seq
+        return seq
+
+    def of_events(self, events: Tuple[Tuple[str, int], ...], module: str,
+                  _depth: int = 0) -> Tuple[str, ...]:
+        """Flattened collective op sequence for an ordered (name, line)
+        event list: direct collective names verbatim, other callees expanded
+        through their own closure."""
+        out: List[str] = []
+        for name, _line in sorted(events, key=lambda p: p[1]):
+            if name in RENDEZVOUS_COLLECTIVES:
+                out.append(name)
+                continue
+            callee = _resolve(self.idx, name, module)
+            if callee is not None:
+                out.extend(self.of_function(callee, _depth=_depth + 1))
+        return tuple(out)
+
+
+def _branch_desc(br) -> str:
+    marks = ", ".join(br.markers) if br.markers else "a rank-derived local"
+    return f"branch conditioned on {marks}"
+
+
+# ---------------------------------------------------------------------------
+
+
+@register
+class CollectiveDivergence(Rule):
+    name = "collective-divergence"
+    severity = "error"
+    description = ("collective reachable under a rank-dependent branch "
+                   "that other ranks skip (deadlock-by-skipped-collective)")
+    rationale = ("process_index/is_writer-style conditions partition the "
+                 "pod; a rendezvous entered by only some arms hangs the "
+                 "ranks that did enter it, with no error anywhere — the "
+                 "engine.py snapshot hang class")
+
+    def check_module(self, ctx: ModuleContext) -> None:
+        return          # purely cross-module: everything happens in check_repo
+
+    def check_repo(self, facts, emit) -> None:
+        clo = _Closure(facts)
+        for ff in facts.all_functions():
+            for br in ff.branches:
+                if not br.rank_dependent:
+                    continue
+                arm_seqs = [clo.of_events(a.events, ff.module)
+                            for a in br.arms]
+                arm_sets = [frozenset(s) for s in arm_seqs]
+                union: Set[str] = set().union(*arm_sets) if arm_sets else set()
+                if not union:
+                    continue
+                if all(s == union for s in arm_sets):
+                    continue          # every arm reaches every collective
+                ops = ", ".join(sorted(union))
+                emit(ff.module, br.line,
+                     f"{_branch_desc(br)} reaches collective(s) [{ops}] in "
+                     "some arms but not all: ranks taking the other arm "
+                     "never enter the rendezvous and the pod deadlocks — "
+                     "hoist the collective out of the branch or make every "
+                     "arm issue the same collective sequence "
+                     f"(in {ff.qual})")
+
+
+@register
+class CollectiveOrder(Rule):
+    name = "collective-order"
+    severity = "error"
+    description = ("rank-divergent branch arms issue the same collectives "
+                   "in different order or multiplicity")
+    rationale = ("when every rank enters a rendezvous but in a different "
+                 "order, psums pair with all_gathers across ranks and the "
+                 "payloads are silently corrupt (or the shapes hang) — "
+                 "order must be verified per code path, not per function")
+
+    def check_module(self, ctx: ModuleContext) -> None:
+        return          # purely cross-module: everything happens in check_repo
+
+    def check_repo(self, facts, emit) -> None:
+        clo = _Closure(facts)
+        for ff in facts.all_functions():
+            for br in ff.branches:
+                if not br.rank_dependent:
+                    continue
+                arm_seqs = [clo.of_events(a.events, ff.module)
+                            for a in br.arms]
+                nonempty = [s for s in arm_seqs if s]
+                if len(nonempty) < 2:
+                    continue
+                sets = {frozenset(s) for s in nonempty}
+                if len(sets) != 1:
+                    continue          # set mismatch: collective-divergence
+                if len(set(nonempty)) == 1:
+                    continue          # identical sequences: consistent
+                shown = " vs ".join(
+                    "[" + ", ".join(s) + "]" for s in dict.fromkeys(nonempty))
+                emit(ff.module, br.line,
+                     f"{_branch_desc(br)}: arms issue the same collectives "
+                     f"in different sequences ({shown}) — ranks taking "
+                     "different arms pair mismatched rendezvous and the "
+                     "payloads corrupt silently; make the per-arm "
+                     f"collective order identical (in {ff.qual})")
+
+
+@register
+class WireDtype(Rule):
+    name = "wire-dtype"
+    severity = "error"
+    description = ("cross-process payload not routed through the uint8 "
+                   "wire codec in parallel/multihost.py")
+    rationale = ("jax runs with x64 disabled: process_allgather silently "
+                 "rounds f64 payloads through f32 and i64 through i32 — "
+                 "the PR 22 bin-mapper byte-divergence class; payloads "
+                 "must cross as raw uint8 via wire_encode/wire_decode")
+
+    def check_module(self, ctx: ModuleContext) -> None:
+        for node in walk(ctx.tree):
+            if not isinstance(node, ast.Call):
+                continue
+            f = node.func
+            name = f.attr if isinstance(f, ast.Attribute) else \
+                f.id if isinstance(f, ast.Name) else ""
+            if name not in _WIRE_CALLS:
+                continue
+            if ctx.relpath == _WIRE_MODULE and \
+                    self._enclosing_func(ctx, node) in _WIRE_BLESSED_FUNCS:
+                continue
+            ctx.report(
+                self, node,
+                f"{name}() outside the multihost.py wire codec: with x64 "
+                "disabled the payload silently rounds f64->f32 / i64->i32 "
+                "across processes — route it through "
+                "parallel/multihost.wire_allgather (raw uint8 via "
+                "wire_encode/wire_decode), or justify why the dtype "
+                "cannot drift")
+
+    @staticmethod
+    def _enclosing_func(ctx: ModuleContext, node: ast.AST) -> Optional[str]:
+        for anc in ctx.ancestors(node):
+            if isinstance(anc, (ast.FunctionDef, ast.AsyncFunctionDef)):
+                return anc.name
+        return None
+
+
+@register
+class NonaddressableAccess(Rule):
+    name = "nonaddressable-access"
+    severity = "error"
+    description = ("host materialization in pod-gated code without an "
+                   "addressability guard")
+    rationale = ("np.asarray/device_get on an array spanning another "
+                 "process's devices raises RuntimeError only on a real "
+                 "pod — single-process CI can never catch it; guard with "
+                 "sharding.is_fully_addressable or gather first")
+
+    def check_module(self, ctx: ModuleContext) -> None:
+        for node in walk(ctx.tree):
+            if not isinstance(node, (ast.FunctionDef, ast.AsyncFunctionDef)):
+                continue
+            tokens = self._tokens(node)
+            if not (tokens & _POD_MARKERS):
+                continue
+            if tokens & _ADDRESSABILITY_GUARDS:
+                continue
+            if self._screens_jax_arrays(node):
+                continue
+            for call in walk(node):
+                if not isinstance(call, ast.Call) or \
+                        not isinstance(call.func, ast.Attribute):
+                    continue
+                attr = call.func.attr
+                if attr not in _HOST_MATERIALIZERS:
+                    continue
+                if attr in ("asarray", "array") and \
+                        not ctx.is_np_attr(call.func):
+                    continue
+                if self._arg_is_gather_result(call):
+                    continue
+                if self._arg_is_literal(call):
+                    continue
+                if self._feeds_collective(ctx, call):
+                    continue
+                ctx.report(
+                    self, call,
+                    f"{attr}() in pod-gated function {node.name}() without "
+                    "an addressability guard: on a multi-process mesh the "
+                    "value may span non-addressable devices and this "
+                    "raises only on a real pod — check "
+                    "x.sharding.is_fully_addressable first (see "
+                    "models/gbdt.py _host_gather) or allgather the value")
+
+    @staticmethod
+    def _tokens(fnode: ast.AST) -> Set[str]:
+        out: Set[str] = set()
+        for sub in walk(fnode):
+            if isinstance(sub, ast.Name):
+                out.add(sub.id)
+            elif isinstance(sub, ast.Attribute):
+                out.add(sub.attr)
+        return out
+
+    @staticmethod
+    def _screens_jax_arrays(fnode: ast.AST) -> bool:
+        """True when the function contains an ``isinstance(x, jax.Array)``
+        test — the author is explicitly routing device arrays away from the
+        host-materialization path, which is the guard this rule wants."""
+        for sub in walk(fnode):
+            if isinstance(sub, ast.Call) and \
+                    isinstance(sub.func, ast.Name) and \
+                    sub.func.id == "isinstance":
+                for a in sub.args[1:]:
+                    for t in walk(a):
+                        if isinstance(t, ast.Attribute) and t.attr == "Array":
+                            return True
+        return False
+
+    @staticmethod
+    def _arg_is_literal(call: ast.Call) -> bool:
+        """``np.array([n_local], np.int64)``-shaped: a literal container or
+        constant is host data by construction, never a sharded array."""
+        if not call.args:
+            return False
+        return isinstance(call.args[0],
+                          (ast.List, ast.Tuple, ast.Dict, ast.Set,
+                           ast.Constant))
+
+    @staticmethod
+    def _feeds_collective(ctx: ModuleContext, call: ast.Call) -> bool:
+        """Materializer nested inside a gather/replicate call
+        (``allgather_rows(np.asarray(v), ...)``): the value is this rank's
+        HOST-LOCAL contribution to the collective — a process-spanning array
+        would be the collective's output, not its input."""
+        sinks = PROC_COLLECTIVES | {"replicate_global"}
+        for anc in ctx.ancestors(call):
+            if isinstance(anc, ast.Call):
+                f = anc.func
+                nm = f.attr if isinstance(f, ast.Attribute) else \
+                    f.id if isinstance(f, ast.Name) else ""
+                if nm in sinks:
+                    return True
+        return False
+
+    @staticmethod
+    def _arg_is_gather_result(call: ast.Call) -> bool:
+        """``np.asarray(process_allgather(...))``-shaped: the gather result
+        is host-local by construction."""
+        for a in list(call.args) + [kw.value for kw in call.keywords]:
+            for sub in walk(a):
+                if isinstance(sub, ast.Call):
+                    f = sub.func
+                    nm = f.attr if isinstance(f, ast.Attribute) else \
+                        f.id if isinstance(f, ast.Name) else ""
+                    if nm in PROC_COLLECTIVES:
+                        return True
+        return False

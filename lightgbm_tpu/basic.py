@@ -485,12 +485,12 @@ class Dataset:
             # cross-rank count aggregation: every rank derives the
             # IDENTICAL bundle plan from the globally-summed histograms
             # and pairwise-conflict counts (plan_bundles docstring;
-            # divergent plans would corrupt the histogram psum)
-            from jax.experimental import multihost_utils
-
+            # divergent plans would corrupt the histogram psum). Counts
+            # cross as raw bytes so i64 tallies arrive exact — the old
+            # jnp round-trip silently truncated them through i32
             def reduce_fn(arr):
-                return np.asarray(multihost_utils.process_allgather(
-                    jnp.asarray(arr))).sum(axis=0)
+                return np.sum(multihost.wire_allgather(
+                    np.ascontiguousarray(arr), uniform=True), axis=0)
         kw = {}
         if presampled:
             # pod mode: the plan thresholds (conflict rates) divide by the

@@ -246,6 +246,11 @@ def train(params: Dict[str, Any], train_set: Dataset,
                     if exp is not None:
                         es_state = exp()
                 try:
+                    # rank-uniform in practice: _gbdt is None on EVERY rank
+                    # or none (boosters construct identically before the
+                    # loop), and write_snapshot enters the same
+                    # get_resume_state collective the elif arm does
+                    # tpu-lint: disable=collective-divergence
                     if snap.is_writer_rank():
                         path = snap.write_snapshot(
                             booster, snapshot_dir, i + 1,

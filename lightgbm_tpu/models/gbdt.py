@@ -44,6 +44,10 @@ def _host_gather(x) -> np.ndarray:
     if fully:
         return np.asarray(x)
     from jax.experimental import multihost_utils
+    # DEVICE-array gather, not a host payload: x already carries the device
+    # dtype (f32/i32), so there is no f64->f32 wire drift to guard against
+    # and the raw-uint8 codec cannot apply before materialization
+    # tpu-lint: disable=wire-dtype
     return np.asarray(multihost_utils.process_allgather(x, tiled=True))
 
 
@@ -356,6 +360,9 @@ class GBDT:
                 # Dataset): pad + re-shard through the host
                 self._mesh = make_mesh()
                 nd = int(self._mesh.devices.size)
+                # this arm only runs when shard_plan is None: bins are a
+                # plain process-local upload, nothing to be non-addressable
+                # tpu-lint: disable=nonaddressable-access
                 bins_np = np.asarray(train_set.bins)
                 padded, self._n_orig = pad_rows_to_devices(bins_np, nd)
                 self._bins_dp = shard_rows(jnp.asarray(padded), self._mesh)

@@ -27,6 +27,10 @@ import jax.numpy as jnp
 import numpy as np
 
 NEG_INF = -1e30
+# relative half-width of the split-gain tie band (~8 f32 ulps): candidates
+# closer than this are "exactly equal" for election purposes and the lowest
+# (feature, bin) index wins — see the tie-break note in best_split
+TIE_RTOL = 1e-6
 
 
 @dataclass(frozen=True)
@@ -486,7 +490,23 @@ def best_split(hist: jnp.ndarray, num_bins: jnp.ndarray, na_bin: jnp.ndarray,
         sections.append(gainB.reshape(L, f * b))
 
     gains = jnp.concatenate(sections, axis=1)
-    flat = jnp.argmax(gains, axis=1)
+    # deterministic tie-break: the winner is the LOWEST flat index whose gain
+    # is within a few-ulp band of the max, not argmax of the raw surface.
+    # Serial row-order accumulation and the data-parallel psum reduce the
+    # same histogram partial sums in different orders, so two mathematically
+    # tied candidates land 1-2 f32 ulps apart with the sign of the gap
+    # depending on the reduction tree — a raw argmax then elects the
+    # neighboring bin on one side and not the other. The band is relative to
+    # the larger of |best| and |parent gain| (penalized planes like CEGB are
+    # small differences of parent-scale quantities, so noise scales with the
+    # parent, not the residual gain).
+    best_raw = gains.max(axis=1)                                  # [L]
+    tie_scale = jnp.maximum(jnp.maximum(jnp.abs(best_raw),
+                                        jnp.abs(parent_gain)), 1.0)
+    near = gains >= (best_raw - TIE_RTOL * tie_scale)[:, None]
+    kidx_flat = jnp.arange(gains.shape[1], dtype=jnp.int32)[None, :]
+    flat = jnp.min(jnp.where(near, kidx_flat, gains.shape[1]), axis=1)
+    flat = jnp.minimum(flat, gains.shape[1] - 1)
     best_gain = jnp.take_along_axis(gains, flat[:, None], axis=1)[:, 0]
     d = flat // (f * b)                # 0/1 numerical planes; >= 2 categorical
     rem = flat % (f * b)

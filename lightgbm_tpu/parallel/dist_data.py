@@ -126,7 +126,6 @@ def find_bin_mappers_distributed(
     encoded mappers; every process decodes the same full list.
     """
     import jax
-    from jax.experimental import multihost_utils
 
     nm = jax.process_count()
     rank = jax.process_index()
@@ -146,10 +145,10 @@ def find_bin_mappers_distributed(
 
     width = _HDR + max(max_bin, *(max_bin_by_feature or [0])) + 2
     # f64 encoding is deliberate: bin upper bounds are doubles in the
-    # reference wire format. The allgather round-trips through the device
-    # dtype, but every rank sees the SAME post-cast values, so the decoded
-    # mappers stay bit-identical across processes — the property this
-    # collective exists to guarantee
+    # reference wire format. The payload crosses as raw bytes through the
+    # multihost wire codec, so the f64 values arrive exact — decoded
+    # mappers are bit-identical across processes AND to the single-host
+    # mappers each rank computed for its own slice
     enc = np.zeros((f, width), dtype=np.float64)   # tpu-lint: disable=dtype-drift
     for j, m in enumerate(local):
         enc[lo + j] = _encode_mapper(m, width)
@@ -160,10 +159,13 @@ def find_bin_mappers_distributed(
     # retried round stays collective-consistent)
     from ..utils import faults
     from ..utils.retry import call_with_backoff
+    from .multihost import wire_allgather
 
     def _gather():
         faults.fault_point("mapper_allgather")
-        return np.asarray(multihost_utils.process_allgather(enc))
+        # every rank's encode buffer is [F, W] regardless of its feature
+        # slice (zeros elsewhere), so the uniform wire path applies
+        return np.stack(wire_allgather(enc, uniform=True))
 
     gathered = call_with_backoff(_gather, attempts=max(1, retries),
                                  base_delay=0.2,

@@ -124,12 +124,12 @@ def consistency_fence(config, train_set=None, raise_on_mismatch: bool = True
     import jax
     if jax.process_count() <= 1:
         return True
-    from jax.experimental import multihost_utils
+    from .multihost import wire_allgather
     items = fence_items(config, train_set)
     local = np.stack([_digest(v) for _n, v in items])       # [n, 2] u32
-    gathered = np.asarray(multihost_utils.process_allgather(local))
-    if gathered.ndim == 2:                                   # [P*n, 2] form
-        gathered = gathered.reshape(-1, local.shape[0], 2)
+    # every rank hashes the same field list, so the digest matrix is a
+    # fixed-shape payload: the uniform wire path gathers it in one round
+    gathered = np.stack(wire_allgather(local, uniform=True))  # [P, n, 2]
     mismatched = [i for i in range(len(items))
                   if not (gathered[:, i] == gathered[0, i]).all()]
     nproc = gathered.shape[0]

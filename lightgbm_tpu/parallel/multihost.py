@@ -183,11 +183,69 @@ def decode_sketches(vec: np.ndarray, num_features: int
 
 def _gather_np(x: np.ndarray) -> np.ndarray:
     """``process_allgather`` with a guaranteed leading rank axis — the
-    single-process shortcut returns the bare payload without one."""
+    single-process shortcut returns the bare payload without one.
+
+    This is the ONE blessed raw ``process_allgather`` call site
+    (tpu-lint ``wire-dtype``): every other cross-process payload goes
+    through :func:`wire_allgather`, which feeds only int32/uint8 arrays
+    here — dtypes that cannot drift under ``jax_enable_x64=False``.
+    """
     import jax
     from jax.experimental import multihost_utils
     out = np.asarray(multihost_utils.process_allgather(x))
     return out.reshape((jax.process_count(),) + x.shape)
+
+
+# ---- raw-uint8 wire codec (the ONLY dtypes allowed on the wire) ----
+# jax runs with x64 disabled, so a collective over an f64/i64 jnp array
+# silently rounds the payload through f32/i32 — the bin-mapper
+# byte-divergence class. Every cross-process payload therefore crosses as
+# raw bytes and is reinterpreted on arrival: wire_encode -> gather ->
+# wire_decode. tpu-lint's wire-dtype rule pins process_allgather to this
+# file's _gather_np; new payloads MUST route through wire_allgather.
+
+
+def wire_encode(arr: np.ndarray) -> np.ndarray:
+    """Contiguous raw-byte (uint8) image of a host array — the only payload
+    representation allowed on the cross-process wire."""
+    return np.frombuffer(np.ascontiguousarray(arr).tobytes(), dtype=np.uint8)
+
+
+def wire_decode(wire: np.ndarray, dtype,
+                trailing_shape: Tuple[int, ...] = ()) -> np.ndarray:
+    """Inverse of :func:`wire_encode`: reinterpret raw bytes as ``dtype``
+    with an inferred leading dimension over ``trailing_shape``."""
+    flat = np.frombuffer(np.ascontiguousarray(wire).tobytes(), dtype=dtype)
+    return flat.reshape((-1,) + tuple(int(t) for t in trailing_shape))
+
+
+def wire_allgather(local: np.ndarray, *, uniform: bool = False
+                   ) -> List[np.ndarray]:
+    """Allgather an arbitrary-dtype host payload as raw bytes.
+
+    Returns one array per rank, each with ``local``'s dtype and trailing
+    shape; leading dimensions may differ across ranks. With
+    ``uniform=True`` the caller asserts every rank contributes an
+    identically-shaped payload, which skips the width-negotiation
+    collective (one gather on the wire instead of two) — use it for
+    fixed-shape payloads like fence digests and (count, offset) metadata.
+    """
+    local = np.ascontiguousarray(local)
+    wire = wire_encode(local)
+    trailing = local.shape[1:] if local.ndim else ()
+    if uniform:
+        gathered = _gather_np(wire if wire.size
+                              else np.zeros(1, dtype=np.uint8))
+        widths = np.full(gathered.shape[0], len(wire), dtype=np.int64)
+    else:
+        widths = _gather_np(np.array([len(wire)],
+                                     dtype=np.int32)).reshape(-1)
+        wmax = max(1, int(widths.max()))
+        padded = np.zeros(wmax, dtype=np.uint8)
+        padded[:len(wire)] = wire
+        gathered = _gather_np(padded)
+    return [wire_decode(gathered[r, :int(widths[r])], local.dtype, trailing)
+            for r in range(gathered.shape[0])]
 
 
 def allgather_sketches(sketches: Sequence[FeatureSketch], retries: int = 3
@@ -202,32 +260,19 @@ def allgather_sketches(sketches: Sequence[FeatureSketch], retries: int = 3
     rank re-enters the same pair, so a retried round stays
     collective-consistent.
     """
-    import jax
-    from jax.experimental import multihost_utils
-
     f = len(sketches)
     enc = encode_sketches(sketches)
 
     def _sync():
         faults.fault_point("sketch_allgather")
-        # the payload crosses the wire as RAW BYTES: jax runs with x64
-        # disabled, so an f64 jnp array would silently round to f32 and the
-        # merged bin bounds would stop being byte-identical to single-host
-        wire = np.frombuffer(enc.tobytes(), dtype=np.uint8)
-        widths = _gather_np(np.array([len(wire)], dtype=np.int32))  # [P, 1]
-        wmax = max(1, int(widths.max()))
-        padded = np.zeros(wmax, dtype=np.uint8)
-        padded[:len(wire)] = wire
-        gathered = _gather_np(padded)                               # [P, wmax]
-        return widths.reshape(-1), gathered
+        # f64 sketch vectors cross as raw bytes (see the wire codec note):
+        # the variable per-rank widths make this the non-uniform path
+        return wire_allgather(enc)
 
-    widths, gathered = call_with_backoff(
+    per_rank_vecs = call_with_backoff(
         _sync, attempts=max(1, retries), base_delay=0.2,
         name="bin-sketch allgather")
-    per_rank = [
-        decode_sketches(np.frombuffer(
-            gathered[r, :int(widths[r])].tobytes(), dtype=np.float64), f)
-        for r in range(jax.process_count())]
+    per_rank = [decode_sketches(vec, f) for vec in per_rank_vecs]
     return [merge_sketches([pr[j] for pr in per_rank]) for j in range(f)]
 
 
@@ -288,33 +333,27 @@ def allgather_rows(local: np.ndarray, n_global: int, row0: int,
     leaves its shards). Hosts may own unequal row counts, so the payload pads
     to the max and a tiny (count, offset) allgather drives reassembly.
     """
-    import jax
-    from jax.experimental import multihost_utils
-
     local = np.ascontiguousarray(local)
     n_local = int(local.shape[0])
-    item = int(np.prod(local.shape[1:], dtype=np.int64)) * local.dtype.itemsize
 
     def _sync():
         faults.fault_point("rows_allgather")
-        meta = _gather_np(np.array([n_local, row0], dtype=np.int32))  # [P, 2]
+        # the (count, offset) meta doubles as width negotiation: every rank
+        # pads its slice to the max count, so the payload gather is uniform
+        meta = np.stack(wire_allgather(
+            np.array([n_local, row0], dtype=np.int32), uniform=True))
         nmax = max(1, int(meta[:, 0].max()))
-        # raw-byte wire: x64 is disabled, so f64/i64 payloads would silently
-        # round through f32/i32 inside the collective (see allgather_sketches)
-        padded = np.zeros(nmax * max(1, item), dtype=np.uint8)
-        padded[:n_local * item] = np.frombuffer(local.tobytes(), np.uint8)
-        gathered = _gather_np(padded)                          # [P, nmax*item]
-        return meta, gathered
+        padded = np.zeros((nmax,) + local.shape[1:], dtype=local.dtype)
+        padded[:n_local] = local
+        return meta, wire_allgather(padded, uniform=True)
 
-    meta, gathered = call_with_backoff(_sync, attempts=max(1, retries),
+    meta, per_rank = call_with_backoff(_sync, attempts=max(1, retries),
                                        base_delay=0.2, name=name)
     out = np.zeros((n_global,) + local.shape[1:], dtype=local.dtype)
-    for r in range(meta.shape[0]):
+    for r, chunk in enumerate(per_rank):
         cnt, off = int(meta[r, 0]), int(meta[r, 1])
         if cnt:
-            out[off:off + cnt] = np.frombuffer(
-                gathered[r, :cnt * item].tobytes(),
-                dtype=local.dtype).reshape((cnt,) + local.shape[1:])
+            out[off:off + cnt] = chunk[:cnt]
     return out
 
 

@@ -58,6 +58,12 @@ def main():
         assert jax.process_count() == nranks, jax.process_count()
     rank = jax.process_index()
 
+    # per-rank collective ledger: every DCN rendezvous this rank issues is
+    # recorded (op, dtype, shape) and written for the parent to cross-check
+    from lightgbm_tpu.analysis import collectivewatch
+    ledger_path = os.path.join(datadir, f"collwatch_rank{rank}.jsonl")
+    collectivewatch.install(ledger_path=ledger_path)
+
     # ---- per-host file-shard ingest: read ONLY this host's row range ----
     xpath = os.path.join(datadir, "X.npy")
     ypath = os.path.join(datadir, "y.npy")
@@ -89,13 +95,15 @@ def main():
     md = mapper_digest(dtrain.mappers)
     td = tree_digest(booster.model_to_string())
     if nranks > 1:
-        # digests must agree across ranks before the parent even looks
-        from jax.experimental import multihost_utils
+        # digests must agree across ranks before the parent even looks;
+        # crossing through the wire codec keeps the worker itself clean
+        # under its own collectivewatch wire-dtype check
         import hashlib
         both = np.frombuffer(
             hashlib.sha256((md + td).encode()).digest()[:16], np.uint32)
-        allv = np.asarray(multihost_utils.process_allgather(both))
+        allv = np.stack(multihost.wire_allgather(both, uniform=True))
         assert np.all(allv == allv[0]), f"ranks diverge: {allv}"
+    collectivewatch.WATCH.write_ledger()
     print(f"POD_OK rank={rank} mode={mode} mappers={md} tree={td}")
 
 

@@ -40,6 +40,14 @@ jax.config.update("jax_compilation_cache_dir", "/tmp/jax_cache_lgbm_tpu")
 jax.config.update("jax_persistent_cache_min_compile_time_secs", 0.05)
 jax.config.update("jax_persistent_cache_enable_xla_caches", "all")
 
+# collectivewatch patches the multihost_utils collective entry points so the
+# suite's DCN rendezvous land in a process-global ledger; unlike lockwatch it
+# needs jax ALREADY importable, so the normal import is fine here. The pod
+# drill workers install their own per-rank instances (see tests/_pod_worker.py)
+from lightgbm_tpu.analysis import collectivewatch
+
+collectivewatch.install()
+
 
 @pytest.fixture
 def rng():
@@ -48,7 +56,7 @@ def rng():
 
 # default wall budget for a @pytest.mark.chaos test: recovery paths that work
 # finish in a few seconds on the CPU mesh, and a HUNG one (deadlocked queue,
-# retry loop that never terminates) must fail here, not at the 870s tier-1
+# retry loop that never terminates) must fail here, not at the tier-1
 # wall where it would take the whole suite down with it
 CHAOS_TIMEOUT_S = 120
 

@@ -1,0 +1,161 @@
+"""collectivewatch unit tests: recording, wire-dtype violations, cross-rank
+ledger comparison, kill-switch, and the conftest-installed patch.
+
+All recording tests use PRIVATE CollectiveWatch instances so nothing here
+contaminates the global WATCH the pod drills inspect; only the patch test
+reads the conftest-installed global, and only by length delta.
+"""
+import json
+import os
+
+import numpy as np
+import pytest
+
+from lightgbm_tpu.analysis import collectivewatch as cw
+
+
+def _note(w, op, arr):
+    w.note(op, arr)
+    return w
+
+
+def test_records_ordered_sequence():
+    w = cw.CollectiveWatch()
+    w.note("process_allgather", np.zeros(3, np.uint8))
+    w.note("process_allgather", np.zeros((2, 4), np.int32))
+    w.note("broadcast_one_to_all", np.zeros(1, np.uint8))
+    assert w.sequence() == [
+        ("process_allgather", "uint8", (3,)),
+        ("process_allgather", "int32", (2, 4)),
+        ("broadcast_one_to_all", "uint8", (1,)),
+    ]
+    assert all(r["host"] for r in w.records)
+
+
+def test_wire_violation_flags_f64_host_payload():
+    """The PR 22 class: a raw f64 numpy payload on the wire (x64 disabled
+    rounds it through f32 in flight) must be reported; codec dtypes not."""
+    w = cw.CollectiveWatch()
+    w.note("process_allgather", np.zeros(8, np.uint8))
+    w.note("process_allgather", np.zeros(2, np.int32))
+    assert w.wire_violations() == []
+    w.note("process_allgather", np.zeros(5, np.float64))
+    bad = w.wire_violations()
+    assert len(bad) == 1 and "float64" in bad[0]
+    with pytest.raises(AssertionError, match="wire-dtype"):
+        w.assert_clean("unit test")
+
+
+def test_device_payloads_exempt_from_wire_check():
+    """A jax.Array already carries the device dtype — f32 on a tiled device
+    gather is not a wire violation (see models/gbdt.py _host_gather)."""
+    import jax.numpy as jnp
+    w = cw.CollectiveWatch()
+    w.note("process_allgather", jnp.zeros(4, jnp.float32))
+    (r,) = w.records
+    assert r["dtype"] == "float32" and not r["host"]
+    assert w.wire_violations() == []
+
+
+def _write_ledger(tmp_path, name, events):
+    w = cw.CollectiveWatch()
+    for op, arr in events:
+        w.note(op, arr)
+    path = str(tmp_path / name)
+    assert w.write_ledger(path) == path
+    return path
+
+
+def test_ledgers_match_when_identical(tmp_path):
+    events = [("process_allgather", np.zeros(4, np.int32)),
+              ("process_allgather", np.zeros(64, np.uint8))]
+    paths = [_write_ledger(tmp_path, f"r{i}.jsonl", events) for i in range(3)]
+    assert cw.compare_ledgers(paths) == []
+    cw.assert_ledgers_match(paths)
+
+
+def test_divergent_sequence_across_ranks_fails(tmp_path):
+    """Rank 1 issues the same two collectives in the OPPOSITE order — the
+    collective-order hazard, caught from the ledgers alone."""
+    a = np.zeros(4, np.int32)
+    b = np.zeros(64, np.uint8)
+    p0 = _write_ledger(tmp_path, "r0.jsonl",
+                       [("process_allgather", a), ("process_allgather", b)])
+    p1 = _write_ledger(tmp_path, "r1.jsonl",
+                       [("process_allgather", b), ("process_allgather", a)])
+    problems = cw.compare_ledgers([p0, p1])
+    assert problems and any("rendezvous #0 diverges" in p for p in problems)
+    with pytest.raises(AssertionError, match="ledger"):
+        cw.assert_ledgers_match([p0, p1], context="unit drill")
+
+
+def test_skipped_rendezvous_count_mismatch(tmp_path):
+    """Rank 1 skips a collective entirely — the collective-divergence
+    (deadlock-by-skipped-rendezvous) hazard."""
+    a = np.zeros(4, np.int32)
+    p0 = _write_ledger(tmp_path, "r0.jsonl",
+                       [("process_allgather", a), ("process_allgather", a)])
+    p1 = _write_ledger(tmp_path, "r1.jsonl", [("process_allgather", a)])
+    problems = cw.compare_ledgers([p0, p1])
+    assert any("COUNT diverges" in p for p in problems)
+
+
+def test_cross_rank_dtype_mismatch_fails(tmp_path):
+    """Same op at the same position but different payload dtype: the ranks
+    agreed to rendezvous and then disagreed about the bytes."""
+    p0 = _write_ledger(tmp_path, "r0.jsonl",
+                       [("process_allgather", np.zeros(4, np.int32))])
+    p1 = _write_ledger(tmp_path, "r1.jsonl",
+                       [("process_allgather", np.zeros(4, np.uint8))])
+    problems = cw.compare_ledgers([p0, p1])
+    assert any("diverges" in p for p in problems)
+
+
+def test_per_rank_wire_violation_surfaces_in_comparison(tmp_path):
+    """Identical sequences on every rank still fail when the shared payload
+    bypassed the codec — the seeded PR 22 f64 regression, runtime side."""
+    events = [("process_allgather", np.zeros(7, np.float64))]
+    paths = [_write_ledger(tmp_path, f"r{i}.jsonl", events) for i in range(2)]
+    problems = cw.compare_ledgers(paths)
+    assert len(problems) == 2 and all("float64" in p for p in problems)
+
+
+def test_kill_switch(monkeypatch):
+    monkeypatch.setenv("LGBMTPU_COLLWATCH", "0")
+    assert cw.install() is False
+
+
+def test_conftest_patch_records_real_collectives():
+    """conftest installed the patch before any test ran: a wire_allgather
+    through the product codec must land in the global ledger as uint8-only
+    payload gathers (plus nothing else from this call)."""
+    from lightgbm_tpu.parallel import multihost
+
+    assert cw.install() is True     # idempotent; proves the patch is live
+    before = len(cw.WATCH.records)
+    out = multihost.wire_allgather(
+        np.arange(6, dtype=np.float64).reshape(2, 3), uniform=True)
+    assert len(out) == 1 and out[0].dtype == np.float64
+    np.testing.assert_array_equal(
+        out[0], np.arange(6, dtype=np.float64).reshape(2, 3))
+    new = cw.WATCH.records[before:]
+    assert new, "patched process_allgather recorded nothing"
+    assert {r["op"] for r in new} == {"process_allgather"}
+    # the codec put ONLY wire dtypes on the collective, f64 payload included
+    assert {r["dtype"] for r in new} <= set(cw.HOST_WIRE_DTYPES)
+    just_new = cw.CollectiveWatch()
+    just_new.records = new
+    assert just_new.wire_violations() == []
+
+
+def test_write_and_read_ledger_roundtrip(tmp_path):
+    w = cw.CollectiveWatch()
+    w.note("sync_global_devices", np.zeros(1, np.uint8))
+    path = str(tmp_path / "ledger.jsonl")
+    w.write_ledger(path)
+    recs = cw.read_ledger(path)
+    assert len(recs) == 1
+    assert recs[0]["op"] == "sync_global_devices"
+    # ledger lines are plain json — the drill harness greps them on failure
+    with open(path) as fh:
+        json.loads(fh.readline())

@@ -117,7 +117,11 @@ def test_cv_basic():
     assert res["auc-mean"][-1] > 0.85
 
 
+@pytest.mark.slow
 def test_cv_early_stopping():
+    # slow tier (~18s: up-to-100-round 3-fold cv); early stopping itself is
+    # tier-1-covered by test_predict_surfaces' best_iteration test and the
+    # engine early-stop tests — this validates the cv() aggregation wiring
     X, y = make_classification(n_samples=600, n_features=8, random_state=3)
     ds = lgb.Dataset(X, label=y, free_raw_data=False)
     res = lgb.cv({"objective": "binary", "num_leaves": 31, "verbosity": -1,

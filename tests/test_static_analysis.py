@@ -594,7 +594,20 @@ def test_unknown_rule_name_raises():
         analyze_source("x = 1\n", rules=["no-such-rule"])
 
 
-def test_baseline_is_empty_by_policy():
+# ---------------------------------------------------------------------------
+# whole-repo gate + reporters + speed + jax-freedom
+
+@pytest.fixture(scope="module")
+def repo_scan():
+    """ONE timed whole-repo scan shared by the gate/baseline/reporter tests:
+    four identical full scans were pure repetition (~15s of tier-1 wall on
+    the 1-core box). Returns (result, wall_seconds)."""
+    t0 = time.perf_counter()
+    res = analyze_paths(baseline_path=DEFAULT_BASELINE)
+    return res, time.perf_counter() - t0
+
+
+def test_baseline_is_empty_by_policy(repo_scan):
     """The v2 triage burned the baseline to zero: every historical finding
     is now either fixed or suppressed INLINE at the site with its
     justification next to the code it excuses. New findings must follow the
@@ -605,18 +618,13 @@ def test_baseline_is_empty_by_policy():
         ("baseline.json grew entries again — fix the finding or move the "
          "justification inline (# tpu-lint: disable=<rule>): "
          + ", ".join(f"{e.path}:{e.line} {e.rule}" for e in entries))
-    res = analyze_paths(baseline_path=DEFAULT_BASELINE)
+    res, _ = repo_scan
     assert not res.stale_baseline
     assert not res.baselined
 
 
-# ---------------------------------------------------------------------------
-# whole-repo gate + reporters + speed + jax-freedom
-
-def test_repo_is_clean_and_fast():
-    t0 = time.perf_counter()
-    res = analyze_paths(baseline_path=DEFAULT_BASELINE)
-    elapsed = time.perf_counter() - t0
+def test_repo_is_clean_and_fast(repo_scan):
+    res, elapsed = repo_scan
     assert not res.parse_errors, [f.render() for f in res.parse_errors]
     assert not res.findings, [f.render() for f in res.findings]
     assert not res.stale_baseline
@@ -624,8 +632,8 @@ def test_repo_is_clean_and_fast():
     assert elapsed < 10.0, f"lint took {elapsed:.1f}s; tier-1 budget is 10s"
 
 
-def test_json_reporter_shape():
-    res = analyze_paths(baseline_path=DEFAULT_BASELINE)
+def test_json_reporter_shape(repo_scan):
+    res, _ = repo_scan
     doc = json.loads(render_json(res))
     assert doc["version"] == 2
     assert doc["summary"]["ok"] is True
@@ -1510,9 +1518,9 @@ def test_changed_files_shape():
     assert files is None or all(f.endswith(".py") for f in files)
 
 
-def test_sarif_reporter_shape():
+def test_sarif_reporter_shape(repo_scan):
     from lightgbm_tpu.analysis import render_sarif
-    res = analyze_paths(baseline_path=DEFAULT_BASELINE)
+    res, _ = repo_scan
     doc = json.loads(render_sarif(res))
     assert doc["version"] == "2.1.0"
     run = doc["runs"][0]
@@ -1564,6 +1572,13 @@ RULE_FIXTURES = {
     "collective-consistency": [("COLLECTIVE_AXIS_FIRE", None),
                                ("CALLBACK_IN_SHARD_MAP_FIRE", None)],
     "compile-budget": "dynamic: tests/test_compile_budget.py",
+    # SPMD pod-safety family (fixtures defined at the end of this file)
+    "collective-divergence": [("COLLDIV_FIRE", None),
+                              ("COLLDIV_TAINTED_FIRE", None)],
+    "collective-order": [("COLLORDER_FIRE", None),
+                         ("COLLORDER_TRANSITIVE_FIRE", None)],
+    "wire-dtype": [("WIRE_DTYPE_FIRE", None)],
+    "nonaddressable-access": [("NONADDR_FIRE", None)],
 }
 
 
@@ -1979,3 +1994,300 @@ def test_multihost_module_in_swallowed_device_error_scope():
     # the module's actual idiom — collectives behind call_with_backoff
     assert "swallowed-device-error" not in names(
         analyze_source(MH_SWALLOWED_CLEAN, relpath=MULTIHOST_REL))
+
+
+# ---- SPMD pod-safety family (PR: tpu-lint v3) ----
+# Four rules over the PR 22 multi-host bug classes: a collective under
+# rank-dependent control flow (deadlock-by-skipped-rendezvous), rank-divergent
+# collective ORDER (silent payload corruption), raw payloads bypassing the
+# multihost.py uint8 wire codec (silent f64->f32 downcast with x64 off), and
+# host materialization of possibly-non-addressable arrays. Runtime
+# counterpart: analysis/collectivewatch.py + the pod drill ledger checks.
+
+COLLDIV_FIRE = """
+import jax
+
+def sync_state(x):
+    from jax.experimental import multihost_utils
+    if jax.process_index() == 0:
+        multihost_utils.process_allgather(x)
+"""
+
+COLLDIV_TAINTED_FIRE = """
+import jax
+
+def sync_state(x, mh):
+    writer = jax.process_index() == 0
+    if writer:
+        mh.allgather_rows(x, 10, 0)
+"""
+
+COLLDIV_SUPPRESSED = """
+import jax
+
+def sync_state(x):
+    from jax.experimental import multihost_utils
+    # every rank enters via the other path  # tpu-lint: disable=collective-divergence
+    if jax.process_index() == 0:
+        multihost_utils.process_allgather(x)
+"""
+
+COLLDIV_CLEAN = """
+import jax
+
+def sync_state(x, mh):
+    if jax.process_index() == 0:
+        out = mh.process_allgather(x)
+    else:
+        out = mh.process_allgather(x)
+    return out
+"""
+
+COLLDIV_RANK_UNIFORM_CLEAN = """
+import jax
+
+def sync_state(x, mh, distributed):
+    if distributed:
+        return mh.process_allgather(x)
+    return x
+"""
+
+
+def test_collective_divergence_fires():
+    assert "collective-divergence" in names(analyze_source(
+        COLLDIV_FIRE, rules=["collective-divergence"]))
+    # one-level taint: a local assigned from process_index partitions too
+    assert "collective-divergence" in names(analyze_source(
+        COLLDIV_TAINTED_FIRE, rules=["collective-divergence"]))
+
+
+def test_collective_divergence_suppressed():
+    assert "collective-divergence" not in names(analyze_source(
+        COLLDIV_SUPPRESSED, rules=["collective-divergence"]))
+    kept = analyze_source(COLLDIV_SUPPRESSED,
+                          rules=["collective-divergence"],
+                          keep_suppressed=True)
+    assert "collective-divergence" in names(kept)
+
+
+def test_collective_divergence_clean():
+    # every arm reaches the collective: no rank can skip the rendezvous
+    assert "collective-divergence" not in names(analyze_source(
+        COLLDIV_CLEAN, rules=["collective-divergence"]))
+    # rank-UNIFORM condition (plain config flag): out of scope by design
+    assert "collective-divergence" not in names(analyze_source(
+        COLLDIV_RANK_UNIFORM_CLEAN, rules=["collective-divergence"]))
+
+
+COLLORDER_FIRE = """
+import jax
+
+def exchange(x, mh):
+    if jax.process_index() == 0:
+        mh.process_allgather(x)
+        mh.broadcast_one_to_all(x)
+    else:
+        mh.broadcast_one_to_all(x)
+        mh.process_allgather(x)
+"""
+
+COLLORDER_SUPPRESSED = """
+import jax
+
+def exchange(x, mh):
+    # tpu-lint: disable=collective-order
+    if jax.process_index() == 0:
+        mh.process_allgather(x)
+        mh.broadcast_one_to_all(x)
+    else:
+        mh.broadcast_one_to_all(x)
+        mh.process_allgather(x)
+"""
+
+COLLORDER_CLEAN = """
+import jax
+
+def exchange(x, mh):
+    if jax.process_index() == 0:
+        mh.process_allgather(x)
+        mh.broadcast_one_to_all(x)
+    else:
+        mh.process_allgather(x)
+        mh.broadcast_one_to_all(x)
+"""
+
+COLLORDER_TRANSITIVE_FIRE = """
+import jax
+
+def gather_then_bcast(x, mh):
+    mh.process_allgather(x)
+    mh.broadcast_one_to_all(x)
+
+def bcast_then_gather(x, mh):
+    mh.broadcast_one_to_all(x)
+    mh.process_allgather(x)
+
+def exchange(x, mh):
+    if jax.process_index() == 0:
+        gather_then_bcast(x, mh)
+    else:
+        bcast_then_gather(x, mh)
+"""
+
+
+def test_collective_order_fires():
+    found = names(analyze_source(COLLORDER_FIRE, rules=["collective-order"]))
+    assert "collective-order" in found
+    # same collectives in both arms: divergence must stay quiet and leave
+    # the finding to the order rule
+    assert "collective-divergence" not in names(analyze_source(
+        COLLORDER_FIRE, rules=["collective-divergence"]))
+
+
+def test_collective_order_sees_through_the_call_graph():
+    assert "collective-order" in names(analyze_source(
+        COLLORDER_TRANSITIVE_FIRE, rules=["collective-order"]))
+
+
+def test_collective_order_suppressed():
+    assert "collective-order" not in names(analyze_source(
+        COLLORDER_SUPPRESSED, rules=["collective-order"]))
+    kept = analyze_source(COLLORDER_SUPPRESSED, rules=["collective-order"],
+                          keep_suppressed=True)
+    assert "collective-order" in names(kept)
+
+
+def test_collective_order_clean():
+    assert "collective-order" not in names(analyze_source(
+        COLLORDER_CLEAN, rules=["collective-order"]))
+
+
+# the seeded PR 22 regression: the ORIGINAL allgather_sketches shape — an
+# f64 sketch vector handed straight to process_allgather, where x64-disabled
+# jax rounds it through f32 and bin bounds stop being byte-identical
+WIRE_DTYPE_FIRE = """
+import numpy as np
+
+def allgather_sketches_legacy(enc):
+    from jax.experimental import multihost_utils
+    gathered = np.asarray(multihost_utils.process_allgather(enc))
+    return gathered
+"""
+
+WIRE_DTYPE_SUPPRESSED = """
+import numpy as np
+
+def gather_device_state(x):
+    from jax.experimental import multihost_utils
+    # device dtype already, tiled gather  # tpu-lint: disable=wire-dtype
+    return np.asarray(multihost_utils.process_allgather(x, tiled=True))
+"""
+
+WIRE_DTYPE_BLESSED_CLEAN = """
+import numpy as np
+
+def _gather_np(x):
+    import jax
+    from jax.experimental import multihost_utils
+    out = np.asarray(multihost_utils.process_allgather(x))
+    return out.reshape((jax.process_count(),) + x.shape)
+"""
+
+
+def test_wire_dtype_seeded_f64_regression_fires():
+    found = analyze_source(WIRE_DTYPE_FIRE, rules=["wire-dtype"])
+    assert "wire-dtype" in names(found)
+    assert any("wire_allgather" in f.message for f in found)
+
+
+def test_wire_dtype_suppressed():
+    assert "wire-dtype" not in names(analyze_source(
+        WIRE_DTYPE_SUPPRESSED, rules=["wire-dtype"]))
+    kept = analyze_source(WIRE_DTYPE_SUPPRESSED, rules=["wire-dtype"],
+                          keep_suppressed=True)
+    assert "wire-dtype" in names(kept)
+
+
+def test_wire_dtype_blessed_codec_site_clean():
+    # the codec's own gather primitive in parallel/multihost.py is the ONE
+    # allowed raw call site...
+    assert "wire-dtype" not in names(analyze_source(
+        WIRE_DTYPE_BLESSED_CLEAN, rules=["wire-dtype"],
+        relpath=MULTIHOST_REL))
+    # ...and ONLY there: the same function anywhere else still fires
+    assert "wire-dtype" in names(analyze_source(
+        WIRE_DTYPE_BLESSED_CLEAN, rules=["wire-dtype"]))
+
+
+NONADDR_FIRE = """
+import numpy as np
+
+def export_scores(score, plan, mh):
+    if mh.plan_spans_processes(plan):
+        return np.asarray(score, np.float32)
+    return None
+"""
+
+NONADDR_SUPPRESSED = """
+import numpy as np
+
+def export_scores(score, plan, mh):
+    if mh.plan_spans_processes(plan):
+        # score is replicated  # tpu-lint: disable=nonaddressable-access
+        return np.asarray(score, np.float32)
+    return None
+"""
+
+NONADDR_GUARDED_CLEAN = """
+import numpy as np
+
+def export_scores(score, plan, mh):
+    if mh.plan_spans_processes(plan):
+        if not score.sharding.is_fully_addressable:
+            score = mh.process_allgather(score, tiled=True)
+        return np.asarray(score, np.float32)
+    return None
+"""
+
+NONADDR_GATHER_FED_CLEAN = """
+import numpy as np
+
+def export_scores(score, plan, mh):
+    if mh.plan_spans_processes(plan):
+        # materializing a gather RESULT is host-local by construction, and
+        # a materializer FEEDING a collective is this rank's contribution
+        full = np.asarray(mh.process_allgather(score))
+        mh.allgather_rows(np.asarray(score, np.float32), 10, 0)
+        return full
+    return None
+"""
+
+NONADDR_LITERAL_CLEAN = """
+import numpy as np
+
+def count_rows(n_local, plan, mh):
+    if mh.plan_spans_processes(plan):
+        return np.array([n_local], np.int64)
+    return None
+"""
+
+
+def test_nonaddressable_access_fires():
+    assert "nonaddressable-access" in names(analyze_source(
+        NONADDR_FIRE, rules=["nonaddressable-access"]))
+
+
+def test_nonaddressable_access_suppressed():
+    assert "nonaddressable-access" not in names(analyze_source(
+        NONADDR_SUPPRESSED, rules=["nonaddressable-access"]))
+    kept = analyze_source(NONADDR_SUPPRESSED,
+                          rules=["nonaddressable-access"],
+                          keep_suppressed=True)
+    assert "nonaddressable-access" in names(kept)
+
+
+def test_nonaddressable_access_clean_variants():
+    for src in (NONADDR_GUARDED_CLEAN, NONADDR_GATHER_FED_CLEAN,
+                NONADDR_LITERAL_CLEAN):
+        assert "nonaddressable-access" not in names(analyze_source(
+            src, rules=["nonaddressable-access"])), src

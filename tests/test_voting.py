@@ -16,8 +16,12 @@ _P = {"objective": "binary", "num_leaves": 15, "verbosity": -1,
       "min_data_in_leaf": 5, "histogram_impl": "scatter"}
 
 
+@pytest.mark.slow
 def test_voting_equals_dp_when_topk_covers_all_features():
-    """top_k >= F elects every feature -> identical to data-parallel."""
+    """top_k >= F elects every feature -> identical to data-parallel.
+    slow tier (~26s): the degenerate-limit equivalence; tier-1 voting
+    coverage stays via the quality/top-k tests below and the 2-rank
+    voting pod drill (test_zz_pod_drill)."""
     X, y = make_classification(n_samples=800, n_features=8, random_state=0)
     b_dp = lgb.train({**_P, "tree_learner": "data"},
                      lgb.Dataset(X, label=y), num_boost_round=8)

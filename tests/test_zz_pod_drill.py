@@ -69,7 +69,27 @@ def _run_pod(mode: str, nranks: int, ndev: int, datadir: str,
         return None
     digests = [_parse_pod_ok(o) for o in outs]
     assert all(d == digests[0] for d in digests), digests
+    _check_ledgers(mode, nranks, datadir)
     return digests[0]
+
+
+def _check_ledgers(mode: str, nranks: int, datadir: str) -> None:
+    """Cross-rank collective-ledger teardown check: every rank must have
+    issued the identical ordered (op, dtype, shape) rendezvous sequence,
+    with zero host payloads outside the uint8/int32 wire codec — the
+    runtime counterpart of the collective-divergence/-order/wire-dtype
+    static rules (workers write the ledgers, see tests/_pod_worker.py)."""
+    from lightgbm_tpu.analysis import collectivewatch
+    paths = [os.path.join(datadir, f"collwatch_rank{r}.jsonl")
+             for r in range(nranks)]
+    for p in paths:
+        assert os.path.exists(p), f"rank ledger missing: {p}"
+    assert_ctx = f"{mode} pod drill ({nranks} ranks)"
+    collectivewatch.assert_ledgers_match(paths, context=assert_ctx)
+    # the drill trains end-to-end: a pod run that never issued a collective
+    # means the patch silently fell off, not that the run was clean
+    assert collectivewatch.read_ledger(paths[0]), \
+        "rank0 ledger is empty — collectivewatch recorded no rendezvous"
 
 
 @pytest.fixture(scope="module")
