@@ -19,9 +19,17 @@ purpose):
 2. ``jnp.asarray(x)`` where ``x`` was built in the same function by a numpy
    constructor with NO dtype (numpy defaults to float64): the implicit-
    default version of the same drift.
+3. a WIDE-INT device request: a ``jnp`` constructor asked for
+   ``int64``/``uint64`` (or ``.astype(jnp.int64)``) — with x64 disabled jax
+   silently narrows the result to int32. For plain indices that truncation
+   is usually survivable; for the packed g/h lattice words of
+   ``ops/pallas_hist`` (guard-bit payloads deliberately sized up to bit 30)
+   it corrupts the high bits with no error anywhere. Host-side ``np.int64``
+   is NOT flagged — numpy keeps 64 bits; only the jnp-side request lies.
 
 An f64 construction immediately wrapped in ``.astype(np.float32)`` is not
-flagged (the precision is transient and the device dtype is explicit).
+flagged (the precision is transient and the device dtype is explicit); the
+same for a wide-int construction immediately ``.astype``-narrowed to int32.
 """
 from __future__ import annotations
 
@@ -41,9 +49,11 @@ class DtypeDrift(Rule):
     name = "dtype-drift"
     severity = "error"
     description = ("np.float64 (explicit or numpy-default) constructed in a "
-                   "function that uploads to device")
+                   "function that uploads to device, or a jnp int64/uint64 "
+                   "request that x64-disabled jax silently narrows")
     rationale = ("TPU f64 is silently downcast at jnp.asarray; split f64/f32 "
-                 "accumulation breaks histogram parity with the reference")
+                 "accumulation breaks histogram parity with the reference, "
+                 "and narrowed int64 corrupts packed guard-bit words")
 
     def check_module(self, ctx: ModuleContext) -> None:
         if not ctx.jnp_aliases and not ctx.jax_aliases:
@@ -62,7 +72,7 @@ class DtypeDrift(Rule):
                 continue
             # explicit float64 construction near device code
             if self._is_f64_call(ctx, node) and \
-                    not self._astype_f32_parent(ctx, node) and \
+                    not self._astype_cast_parent(ctx, node, _is_f32_expr) and \
                     id(node) not in reported:
                 reported.add(id(node))
                 ctx.report(self, node,
@@ -70,6 +80,21 @@ class DtypeDrift(Rule):
                            "the device API; TPU downcasts to f32 at upload "
                            "— cast explicitly, or suppress with a comment "
                            "stating the precision requirement")
+            # wide-int device request: jnp ctor dtype=int64/uint64 (or
+            # .astype(jnp.int64)) — x64-disabled jax narrows to int32
+            # silently, which shears the high bits off packed guard-bit
+            # lattice words (ops/pallas_hist packs payloads up to bit 30)
+            if self._is_i64_call(ctx, node) and \
+                    not self._astype_cast_parent(ctx, node, _is_i32_expr) and \
+                    id(node) not in reported:
+                reported.add(id(node))
+                ctx.report(self, node,
+                           "int64/uint64 requested for a device array; "
+                           "x64-disabled jax silently narrows to int32 — "
+                           "packed guard-bit words lose their high bits "
+                           "with no error; build in int32 (numpy keeps "
+                           "64-bit host-side), or suppress with a comment "
+                           "stating why the width survives")
             # record dtype-less numpy ctor assignments (implicit float64)
             if isinstance(node.func, ast.Attribute) and \
                     ctx.is_np_attr(node.func) and \
@@ -113,15 +138,35 @@ class DtypeDrift(Rule):
             return True
         return False
 
-    def _astype_f32_parent(self, ctx: ModuleContext, node: ast.AST) -> bool:
-        """True when the f64 value is immediately ``.astype(np.float32)``'d
-        (or f32-cast) — transient host precision, no drift."""
+    def _is_i64_call(self, ctx: ModuleContext, node: ast.Call) -> bool:
+        """A construction that asks the DEVICE for a 64-bit integer: a jnp
+        constructor with dtype int64/uint64, or ``.astype(jnp.int64)`` (the
+        jnp attribute specifically — ``x.astype(np.int64)`` stays host-side
+        numpy and keeps its 64 bits, so it is not flagged)."""
+        f = node.func
+        if isinstance(f, ast.Attribute) and f.attr == "astype" and node.args:
+            a = node.args[0]
+            return ctx.is_jnp_attr(a) and a.attr in ("int64", "uint64")
+        if not (ctx.is_jnp_attr(f) and f.attr in _NP_CTORS):
+            return False
+        for kw in node.keywords:
+            if kw.arg == "dtype" and _is_i64_expr(ctx, kw.value):
+                return True
+        pos = _dtype_pos(f.attr)
+        if len(node.args) > pos and _is_i64_expr(ctx, node.args[pos]):
+            return True
+        return False
+
+    def _astype_cast_parent(self, ctx: ModuleContext, node: ast.AST,
+                            pred) -> bool:
+        """True when the value is immediately ``.astype(<narrow dtype>)``'d
+        (``pred`` matches the target) — transient width, no drift."""
         parent = ctx.parents.get(node)
         attr = parent if isinstance(parent, ast.Attribute) else None
         if attr is not None and attr.attr == "astype":
             call = ctx.parents.get(attr)
             if isinstance(call, ast.Call) and call.args and \
-                    _is_f32_expr(ctx, call.args[0]):
+                    pred(ctx, call.args[0]):
                 return True
         return False
 
@@ -142,3 +187,17 @@ def _is_f32_expr(ctx: ModuleContext, node: ast.AST) -> bool:
         return True
     return isinstance(node, ast.Attribute) and node.attr in ("float32",
                                                              "bfloat16")
+
+
+def _is_i64_expr(ctx: ModuleContext, node: ast.AST) -> bool:
+    if isinstance(node, ast.Constant) and node.value in ("int64", "uint64"):
+        return True
+    return isinstance(node, ast.Attribute) and node.attr in ("int64",
+                                                             "uint64")
+
+
+def _is_i32_expr(ctx: ModuleContext, node: ast.AST) -> bool:
+    if isinstance(node, ast.Constant) and node.value in ("int32", "uint32"):
+        return True
+    return isinstance(node, ast.Attribute) and node.attr in ("int32",
+                                                             "uint32")

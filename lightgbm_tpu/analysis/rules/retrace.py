@@ -14,8 +14,11 @@ Three sub-patterns, all observed (and paid for) in this codebase's history
    are hash-keyed, so an unhashable default raises at call time, and a
    mutable one silently keys the cache by identity (retrace per instance).
    Also flags ``static_argnames`` naming a parameter the function does not
-   have (the undeclared-static case: the arg stays traced and every distinct
-   value retraces).
+   have, and ``static_argnums`` indices outside the function's positional
+   parameter range (both are the undeclared-static case: jax either errors
+   late or the intended arg simply stays traced, and every distinct value
+   retraces — e.g. a ``pack_k`` guard-bit width meant to be a compile-time
+   constant would quietly become a per-value executable variant).
 3. **traced-branch**: an ``if``/``while`` test built from a ``jnp``/
    ``jax.lax`` call inside a jitted function — Python control flow on traced
    values fails at trace time; shape-based branching is fine (shapes are
@@ -79,6 +82,25 @@ class RetraceHazard(Rule):
                            f"{getattr(fn, 'name', '<lambda>')}() has no "
                            "such parameter; the real arg stays traced and "
                            "every distinct value retraces")
+        # static_argnums past the positional parameter list: the index maps
+        # to nothing, so the arg it was meant to pin stays traced (and in a
+        # *args function jax may only fail at call time, if at all)
+        pos_params = [p.arg for p in a.posonlyargs + a.args]
+        for kw in call.keywords:
+            if kw.arg != "static_argnums":
+                continue
+            for sub in walk(kw.value):
+                if isinstance(sub, ast.Constant) and \
+                        isinstance(sub.value, int) and \
+                        not isinstance(sub.value, bool) and \
+                        not 0 <= sub.value < len(pos_params):
+                    ctx.report(self, call,
+                               f"static_argnums index {sub.value} is out of "
+                               "range for "
+                               f"{getattr(fn, 'name', '<lambda>')}()'s "
+                               f"{len(pos_params)} positional parameter(s); "
+                               "the intended arg stays traced and every "
+                               "distinct value retraces")
         for name in declared | static_names_from_call(call, fn):
             d = defaults.get(name)
             if isinstance(d, (ast.List, ast.Dict, ast.Set)):

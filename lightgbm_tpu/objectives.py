@@ -87,7 +87,14 @@ class RegressionL2(ObjectiveFunction):
         if self.config.reg_sqrt:
             self._raw_label = label
             self.label = jnp.sign(label) * jnp.sqrt(jnp.abs(label))
-        self.is_constant_hessian = weight is None
+        # AND with the class-level bit: subclasses with per-row hessians
+        # (huber/fair/poisson/gamma/tweedie) declare False and must keep it —
+        # a bare `weight is None` here used to overwrite their flag to True,
+        # which would make the q8 const-hessian channel elision reconstruct
+        # count * max(h) instead of sum(h) for them (caught by
+        # tests/test_objectives_battery.py's flag-vs-hessian property test)
+        self.is_constant_hessian = (type(self).is_constant_hessian
+                                    and weight is None)
 
     def get_gradients(self, score):
         grad = score - self.label

@@ -191,6 +191,27 @@ def hist_leaf_pallas(bins_T, g, h, c, num_bins: int,
 # channels at 2x int8 throughput — ~3.3x fewer effective flops. The int32
 # accumulator is exact up to ~16M rows/shard per (slot, feature, bin) cell
 # (127 * 16.9M = 2^31), far beyond any real per-cell mass.
+#
+# Packed g/h lattice (Shi et al. §4.2 — the guard-bit packing LightGBM 4.x
+# ships inside quantized training): when ``pack_k > 0`` the int8 g row and
+# the low channel (hq, or the 0/1 count under const-hessian elision) are
+# packed into ONE int32 word ``w = gq * 2^k + low`` with k guard bits sized
+# so a whole per-(slot, feature, bin) cell's low-field sum can never carry
+# into g's field: k = bit_length(low_max * n_rows). The MXU then accumulates
+# ONE packed channel instead of two, and the reduced histogram unpacks
+# exactly:
+#
+#   P = sum(w) = Gsum * 2^k + Lsum   with 0 <= Lsum < 2^k
+#   Lsum = P & (2^k - 1);  Gsum = P >> k   (arithmetic shift = floor
+#   division — exact in two's complement because Lsum never borrows)
+#
+# Channel counts per variant: 3 (plain), 2 (const-hess elision, or packed
+# g+h with a separate count), 1 (packed g+count under const-hess). The
+# packed contraction runs int32 x int32 — widening the 0/1 one-hot is exact
+# — and every int32 op here is replayed identically by the CPU interpreter,
+# so packed-vs-unpacked bit-identity is provable off-TPU.
+# ops/histogram.py pack_guard_bits() owns the overflow budget and returns 0
+# (fall back to the unpacked kernels) when int32 can't hold the worst case.
 # ---------------------------------------------------------------------------
 
 def _onehot_i8(bins_i, fg: int, b: int, chunk: int, swar: bool):
@@ -237,15 +258,26 @@ def _swar_ok(b: int, interpret: bool) -> bool:
     return (not interpret) and b % 4 == 0 and b <= 128
 
 
+def _pack_rows_i32(g, low, pack_k: int):
+    """[1, C] int32 packed lattice rows: w = g * 2^k + low (low in [0, 2^k))."""
+    return g * jnp.int32(1 << pack_k) + low
+
+
 def _kernel_q8(bins_ref, gq_ref, hq_ref, c_ref, slot_ref, out_ref, *,
                fg: int, b: int, s: int, chunk: int, nch: int = 3,
-               swar: bool = False):
+               swar: bool = False, pack_k: int = 0):
     """One (feature-group j, row-chunk i) grid step, int8 x int8 -> int32.
 
     bins_ref: [Fg, C] uint8; gq/hq/c_ref: [C] int8; slot_ref: [C] i32;
     out_ref: [Fg*B, S*nch] i32 accumulated across i. nch=2 is the
     constant-hessian variant (channels (gq, count); hq_ref unused — the
-    hessian histogram is count * scale_h/127, reconstructed by the caller)."""
+    hessian histogram is count * scale_h/127, reconstructed by the caller).
+
+    pack_k > 0 is the packed g/h lattice (module comment above): the g row
+    and the low channel (hq, or count when nch == 1) fold into one int32
+    word, the contraction runs int32 x int32 and the caller unpacks the
+    accumulated word exactly. nch is then the EFFECTIVE channel count:
+    1 = packed (g, count) under const-hess, 2 = packed (g, h) + count."""
     i = pl.program_id(1)
 
     @pl.when(i == 0)
@@ -261,7 +293,11 @@ def _kernel_q8(bins_ref, gq_ref, hq_ref, c_ref, slot_ref, out_ref, *,
     # exact)
     g = gq_ref[:].reshape(1, chunk).astype(jnp.int32)
     c = c_ref[:].reshape(1, chunk).astype(jnp.int32)
-    if nch == 3:
+    if pack_k > 0:
+        low = c if nch == 1 else hq_ref[:].reshape(1, chunk).astype(jnp.int32)
+        packed = _pack_rows_i32(g, low, pack_k)                 # [1, C] i32
+        ghc = packed if nch == 1 else jnp.concatenate([packed, c], axis=0)
+    elif nch == 3:
         h = hq_ref[:].reshape(1, chunk).astype(jnp.int32)
         ghc = jnp.concatenate([g, h, c], axis=0)                # [3, C] i32
     else:
@@ -271,18 +307,72 @@ def _kernel_q8(bins_ref, gq_ref, hq_ref, c_ref, slot_ref, out_ref, *,
     slot = slot_ref[:].reshape(1, chunk)
     slot_of_row = jax.lax.broadcasted_iota(
         jnp.int32, (s * nch, chunk), 0) // nch
-    w = jnp.where(slot == slot_of_row, w, 0).astype(jnp.int8)
-
-    part = jax.lax.dot_general(
-        onehot, w, dimension_numbers=(((1,), (1,)), ((), ())),
-        preferred_element_type=jnp.int32)                       # [Fg*B, S*nch]
+    if pack_k > 0:
+        # packed words exceed int8 — keep the weights int32 and widen the
+        # 0/1 one-hot to match (exact; the MXU still contracts one channel
+        # fewer, which is the whole point)
+        w = jnp.where(slot == slot_of_row, w, 0)
+        part = jax.lax.dot_general(
+            onehot.astype(jnp.int32), w,
+            dimension_numbers=(((1,), (1,)), ((), ())),
+            preferred_element_type=jnp.int32)                   # [Fg*B, S*nch]
+    else:
+        w = jnp.where(slot == slot_of_row, w, 0).astype(jnp.int8)
+        part = jax.lax.dot_general(
+            onehot, w, dimension_numbers=(((1,), (1,)), ((), ())),
+            preferred_element_type=jnp.int32)                   # [Fg*B, S*nch]
     out_ref[:] += part
+
+
+def _q8_nch(const_hess: bool, pack_k: int) -> int:
+    """Effective MXU channel count for the q8 kernels: 3 plain, 2 const-hess
+    or packed, 1 packed + const-hess."""
+    if pack_k > 0:
+        return 1 if const_hess else 2
+    return 2 if const_hess else 3
+
+
+def _assert_pack_budget(n: int, pack_k: int, const_hess: bool) -> None:
+    """Trace-time overflow-safety assert for the packed lattice: the guard
+    field must hold the worst-case per-(slot, feature, bin) low-field sum
+    (every row in one cell) and the packed int32 word sum must fit int32.
+    Callers size pack_k via ops/histogram.py pack_guard_bits, which returns
+    0 when this cannot hold — tripping here means a caller bypassed it."""
+    low_max = 1 if const_hess else 127
+    assert low_max * n < (1 << pack_k), (
+        f"packed-lattice guard bits too small: {pack_k} bits cannot hold "
+        f"low_max*n = {low_max * n}")
+    assert 127 * n * (1 << pack_k) + low_max * n <= (1 << 31) - 1, (
+        f"packed-lattice int32 overflow: n={n} rows at pack_k={pack_k}")
+
+
+def _dequant_stack(out, pack_k: int, const_hess: bool, sg, sh):
+    """[..., nch] int32 accumulator -> [..., 3] f32 (g, h, count) channels.
+
+    pack_k > 0 unpacks the packed word exactly (Lsum = P & (2^k-1),
+    Gsum = P >> k — module comment above); const_hess reconstructs the
+    hessian channel as count * sh (sh = scale_h/127 with scale_h =
+    127 * h_const, see ops/histogram.py make_quant). The f32 casts and
+    multiply order match the unpacked path bit-for-bit."""
+    if pack_k > 0:
+        p = out[..., 0]
+        low = (p & jnp.int32((1 << pack_k) - 1)).astype(jnp.float32)
+        gsum = (p >> pack_k).astype(jnp.float32)
+        cnt = low if const_hess else out[..., 1].astype(jnp.float32)
+        hch = cnt * sh if const_hess else low * sh
+        return jnp.stack([gsum * sg, hch, cnt], axis=-1)
+    out = out.astype(jnp.float32)
+    if const_hess:
+        cnt = out[..., 1]
+        return jnp.stack([out[..., 0] * sg, cnt * sh, cnt], axis=-1)
+    return jnp.stack([out[..., 0] * sg, out[..., 1] * sh, out[..., 2]],
+                     axis=-1)
 
 
 def hist_pallas_q8(bins_T: jnp.ndarray, gq: jnp.ndarray, hq: jnp.ndarray,
                    cq: jnp.ndarray, slot: jnp.ndarray, num_slots: int,
                    num_bins: int, scale_g, scale_h, chunk: int = _CHUNK_Q8,
-                   const_hess: bool = False,
+                   const_hess: bool = False, pack_k: int = 0,
                    interpret: bool = False) -> jnp.ndarray:
     """Slot-routed histogram from int8-quantized channels.
 
@@ -291,18 +381,26 @@ def hist_pallas_q8(bins_T: jnp.ndarray, gq: jnp.ndarray, hq: jnp.ndarray,
     (traced f32 scalars). Returns [S, 3, F, B] f32 with grad/hess channels
     dequantized (count channel is exact). const_hess drops the in-kernel
     hessian channel (2-channel MXU contraction) and reconstructs it as
-    count * scale_h/127 — exact for h = h_const * bag01 rows."""
+    count * scale_h/127 — exact for h = h_const * bag01 rows. pack_k > 0
+    additionally folds g and the low channel into one packed int32 word
+    (module comment above) — callers size it with ops/histogram.py
+    pack_guard_bits and MUST pass 0 when that returns 0."""
     f, n = bins_T.shape
     b, s = num_bins, num_slots
-    nch = 2 if const_hess else 3
+    nch = _q8_nch(const_hess, pack_k)
+    if pack_k > 0:
+        _assert_pack_budget(n, pack_k, const_hess)
     fg = max(1, min(f, _ACC_ROWS_MAX // b))
     if chunk == _CHUNK_Q8:
         # the 4096 default is budgeted for the SWAR one-hot at the bench
         # shape (fg*b = 1792 rows measured fitting VMEM at S=127); wider
         # feature groups (fg*b = 2048 at 700 features: measured 16.75MB,
         # 764KB over the scoped-vmem limit) or the compare path's int32
-        # broadcast intermediates keep the old 2048 chunk
-        if not _swar_ok(b, interpret) or fg * b > 1792 or s * nch > 384:
+        # broadcast intermediates keep the old 2048 chunk. The packed
+        # lattice widens the one-hot operand to int32 (4x the bytes), so it
+        # also keeps the conservative chunk
+        if (not _swar_ok(b, interpret) or fg * b > 1792 or s * nch > 384
+                or pack_k > 0):
             chunk = 2048
     n_fg = -(-f // fg)
     f_pad = n_fg * fg
@@ -318,7 +416,8 @@ def hist_pallas_q8(bins_T: jnp.ndarray, gq: jnp.ndarray, hq: jnp.ndarray,
     n_chunks = bins_T.shape[1] // chunk
 
     kern = functools.partial(_kernel_q8, fg=fg, b=b, s=s, chunk=chunk,
-                             nch=nch, swar=_swar_ok(b, interpret))
+                             nch=nch, swar=_swar_ok(b, interpret),
+                             pack_k=pack_k)
     out = pl.pallas_call(
         kern,
         grid=(n_fg, n_chunks),
@@ -344,22 +443,17 @@ def hist_pallas_q8(bins_T: jnp.ndarray, gq: jnp.ndarray, hq: jnp.ndarray,
         interpret=interpret,
     )(bins_T, gq, hq, cq, slot)
 
-    out = out.reshape(f_pad, b, s, nch).astype(jnp.float32)
+    out = out.reshape(f_pad, b, s, nch)
     sg = scale_g * jnp.float32(1.0 / 127.0)
     sh = scale_h * jnp.float32(1.0 / 127.0)
-    if const_hess:
-        cnt = out[..., 1]
-        hist = jnp.stack([out[..., 0] * sg, cnt * sh, cnt],
-                         axis=-1).transpose(2, 3, 0, 1)
-    else:
-        hist = jnp.stack([out[..., 0] * sg, out[..., 1] * sh, out[..., 2]],
-                         axis=-1).transpose(2, 3, 0, 1)
+    hist = _dequant_stack(out, pack_k, const_hess, sg, sh) \
+        .transpose(2, 3, 0, 1)
     return hist[:, :, :f, :]
 
 
 def _kernel_q8_fused(*refs, f: int, b: int, s: int, l: int, chunk: int,
                      has_cat: bool, nch: int = 3, swar: bool = False,
-                     d: int = 1):
+                     d: int = 1, pack_k: int = 0):
     """Fused route + int8 histogram for ONE feature group (F*B <= block cap).
 
     Per level the two-pass scheme reads the bin matrix twice (route kernel,
@@ -402,7 +496,12 @@ def _kernel_q8_fused(*refs, f: int, b: int, s: int, l: int, chunk: int,
     onehot = _onehot_i8(bins_i, f, b, chunk, swar)
     g = gq_ref[:].reshape(1, chunk).astype(jnp.int32)
     c = cq_ref[:].reshape(1, chunk).astype(jnp.int32)
-    if nch == 3:
+    if pack_k > 0:   # packed lattice (see _kernel_q8): nch is EFFECTIVE
+        low = c if nch == 1 else hq_ref[:].reshape(1, chunk).astype(jnp.int32)
+        packed = _pack_rows_i32(g, low, pack_k)
+        ghc = packed if nch == 1 else jnp.concatenate([packed, c], axis=0)
+        onehot = onehot.astype(jnp.int32)   # hoisted: shared by all d levels
+    elif nch == 3:
         h = hq_ref[:].reshape(1, chunk).astype(jnp.int32)
         ghc = jnp.concatenate([g, h, c], axis=0)
     else:   # constant hessian: (gq, count) only
@@ -448,7 +547,9 @@ def _kernel_q8_fused(*refs, f: int, b: int, s: int, l: int, chunk: int,
         slot = jnp.minimum(slot_f.astype(jnp.int32), s)          # [1, C]
 
         # ---- int8 histogram (see _kernel_q8 / _onehot_i8) ----
-        w = jnp.where(slot == slot_of_row, wv, 0).astype(jnp.int8)
+        w = jnp.where(slot == slot_of_row, wv, 0)
+        if pack_k == 0:
+            w = w.astype(jnp.int8)
         part = jax.lax.dot_general(
             onehot, w, dimension_numbers=(((1,), (1,)), ((), ())),
             preferred_element_type=jnp.int32)
@@ -474,7 +575,7 @@ def hist_routed_fused_multi_q8(bins_T, gq, hq, cq, leaf_id, tables_seq,
                                na_bin, num_slots: int, num_bins: int,
                                scale_g, scale_h, num_leaves: int,
                                chunk: int = 0, const_hess: bool = False,
-                               interpret: bool = False):
+                               pack_k: int = 0, interpret: bool = False):
     """Multi-level fused route+histogram megapass.
 
     ``tables_seq``: sequence of D per-level RouteTables. ONE kernel launch
@@ -489,20 +590,24 @@ def hist_routed_fused_multi_q8(bins_T, gq, hq, cq, leaf_id, tables_seq,
 
     Only valid when every feature fits one accumulator block
     (F * num_bins <= _ACC_ROWS_MAX) — the router must see ALL columns.
-    const_hess: see hist_pallas_q8."""
+    const_hess / pack_k: see hist_pallas_q8."""
     f, n = bins_T.shape
     b, s, l = num_bins, num_slots, num_leaves
     d = len(tables_seq)
-    nch = 2 if const_hess else 3
+    nch = _q8_nch(const_hess, pack_k)
     assert f * b <= _ACC_ROWS_MAX
+    if pack_k > 0:
+        _assert_pack_budget(n, pack_k, const_hess)
     if chunk == 0:
         # doubled chunk halves per-chunk fixed costs; the SWAR int8
         # one-hot keeps 4096 under the 16MB VMEM ceiling through S=127
         # (measured 35 -> 31.7 ms at S=127). Without SWAR (B > 128 or
         # interpret) the compare path's wider intermediates keep the old
-        # 192-row threshold. The accumulator band is D levels wide.
+        # 192-row threshold. The accumulator band is D levels wide. The
+        # packed lattice widens the one-hot to int32 (4x bytes): keep the
+        # conservative chunk there too
         wide_ok = 384 if (_swar_ok(b, interpret) and f * b <= 1792) else 192
-        chunk = 4096 if d * s * nch <= wide_ok else 2048
+        chunk = 4096 if (d * s * nch <= wide_ok and pack_k == 0) else 2048
 
     has_cat = any(t.is_cat is not None for t in tables_seq)
     tabs = jnp.concatenate([_route_tabs(t, l) for t in tables_seq], axis=0)
@@ -539,7 +644,7 @@ def hist_routed_fused_multi_q8(bins_T, gq, hq, cq, leaf_id, tables_seq,
 
     kern = functools.partial(_kernel_q8_fused, f=f, b=b, s=s, l=l,
                              chunk=chunk, has_cat=has_cat, nch=nch,
-                             swar=_swar_ok(b, interpret), d=d)
+                             swar=_swar_ok(b, interpret), d=d, pack_k=pack_k)
     out, lid2 = pl.pallas_call(
         kern,
         grid=(n_chunks,),
@@ -560,23 +665,18 @@ def hist_routed_fused_multi_q8(bins_T, gq, hq, cq, leaf_id, tables_seq,
         interpret=interpret,
     )(*args)
 
-    out = out.reshape(f, b, d, s, nch).astype(jnp.float32)
+    out = out.reshape(f, b, d, s, nch)
     sg = scale_g * jnp.float32(1.0 / 127.0)
     sh = scale_h * jnp.float32(1.0 / 127.0)
-    if const_hess:
-        cnt = out[..., 1]
-        hist = jnp.stack([out[..., 0] * sg, cnt * sh, cnt],
-                         axis=-1).transpose(2, 3, 4, 0, 1)
-    else:
-        hist = jnp.stack([out[..., 0] * sg, out[..., 1] * sh, out[..., 2]],
-                         axis=-1).transpose(2, 3, 4, 0, 1)
+    hist = _dequant_stack(out, pack_k, const_hess, sg, sh) \
+        .transpose(2, 3, 4, 0, 1)
     return hist, lid2[:n]
 
 
 def hist_routed_fused_q8(bins_T, gq, hq, cq, leaf_id, tables, na_bin,
                          num_slots: int, num_bins: int, scale_g, scale_h,
                          num_leaves: int, chunk: int = 0,
-                         const_hess: bool = False,
+                         const_hess: bool = False, pack_k: int = 0,
                          interpret: bool = False):
     """Fused route+histogram level pass. Returns ([S, 3, F, B] f32, lid2 [N]).
 
@@ -586,7 +686,7 @@ def hist_routed_fused_q8(bins_T, gq, hq, cq, leaf_id, tables, na_bin,
     hist, lid2 = hist_routed_fused_multi_q8(
         bins_T, gq, hq, cq, leaf_id, (tables,), na_bin, num_slots, num_bins,
         scale_g, scale_h, num_leaves, chunk=chunk, const_hess=const_hess,
-        interpret=interpret)
+        pack_k=pack_k, interpret=interpret)
     return hist[0], lid2
 
 
@@ -707,7 +807,7 @@ def _grad_rows(spec, score, aux):
 def _grad_quant_kernel(bins_ref, score_ref, aux_ref, bag_ref, seed_ref,
                        gq_ref, hq_ref, cq_ref, sc_ref, out_ref, mx_ref, *,
                        f: int, b: int, chunk: int, spec,
-                       const_hess: bool, swar: bool):
+                       const_hess: bool, swar: bool, pack_k: int = 0):
     """Two-phase fused gradient + SR-quantization + root histogram.
 
     grid (2, n_chunks) — the TPU grid runs the trailing axis innermost, so
@@ -720,8 +820,10 @@ def _grad_quant_kernel(bins_ref, score_ref, aux_ref, bag_ref, seed_ref,
     bins [F, C] u8; score/aux/bag [C] f32; seed (1, 1) i32 SMEM; outputs
     gq/hq/cq [C] i8, sc (8, 128) f32 (row 0 lane 0 = scale_g, row 1 lane 0 =
     scale_h), out [F*B, nch] i32; scratch mx (2, 128) f32 lane-max partials.
+    pack_k > 0 packs the hist0 weight rows into the g/h lattice word
+    (see _kernel_q8) — the emitted gq/hq/cq row channels are unchanged.
     """
-    nch = 2 if const_hess else 3
+    nch = _q8_nch(const_hess, pack_k)
     p = pl.program_id(0)
     i = pl.program_id(1)
 
@@ -779,42 +881,65 @@ def _grad_quant_kernel(bins_ref, score_ref, aux_ref, bag_ref, seed_ref,
         cq_ref[:] = cw.astype(jnp.int8).reshape(chunk)
         if const_hess:
             hq_ref[:] = jnp.zeros_like(hq_ref)
-            w3 = jnp.concatenate([gq.astype(jnp.int32),
-                                  cw.astype(jnp.int32)], axis=0)
+            if pack_k > 0:
+                w3 = _pack_rows_i32(gq.astype(jnp.int32),
+                                    cw.astype(jnp.int32), pack_k)
+            else:
+                w3 = jnp.concatenate([gq.astype(jnp.int32),
+                                      cw.astype(jnp.int32)], axis=0)
         else:
             uh = _sr_dither(idx, seed, 2)
             hq = jnp.clip(jnp.floor(h * (127.0 / scale_h) + uh), -127, 127)
             hq_ref[:] = hq.astype(jnp.int8).reshape(chunk)
-            w3 = jnp.concatenate([gq.astype(jnp.int32), hq.astype(jnp.int32),
-                                  cw.astype(jnp.int32)], axis=0)
+            if pack_k > 0:
+                w3 = jnp.concatenate([
+                    _pack_rows_i32(gq.astype(jnp.int32),
+                                   hq.astype(jnp.int32), pack_k),
+                    cw.astype(jnp.int32)], axis=0)
+            else:
+                w3 = jnp.concatenate([gq.astype(jnp.int32),
+                                      hq.astype(jnp.int32),
+                                      cw.astype(jnp.int32)], axis=0)
         bins_i = bins_ref[:].astype(jnp.int32)
         onehot = _onehot_i8(bins_i, f, b, chunk, swar)
-        part = jax.lax.dot_general(
-            onehot, w3.astype(jnp.int8),
-            dimension_numbers=(((1,), (1,)), ((), ())),
-            preferred_element_type=jnp.int32)                     # [F*B, nch]
+        if pack_k > 0:   # int32 weights: widen the 0/1 one-hot (exact)
+            part = jax.lax.dot_general(
+                onehot.astype(jnp.int32), w3,
+                dimension_numbers=(((1,), (1,)), ((), ())),
+                preferred_element_type=jnp.int32)                 # [F*B, nch]
+        else:
+            part = jax.lax.dot_general(
+                onehot, w3.astype(jnp.int8),
+                dimension_numbers=(((1,), (1,)), ((), ())),
+                preferred_element_type=jnp.int32)                 # [F*B, nch]
         out_ref[:] += part
 
 
 def grad_quant_hist0_pallas(bins_T, score, aux, bag, seed, spec,
                             num_bins: int, const_hess: bool = False,
-                            chunk: int = 0, interpret: bool = False):
+                            pack_k: int = 0, chunk: int = 0,
+                            interpret: bool = False):
     """Fused objective gradient + int8 quantization + root histogram.
 
     Returns (gq [N] i8, hq [N] i8 | None, cq [N] i8, scale_g f32 scalar,
     scale_h f32 scalar, hist0 [3, F, B] f32) — bit-identical to the unfused
     objective.get_gradients -> make_quant -> hist_leaf chain on the Pallas
     path (f32 max is order-independent, the dither hash is replayed exactly,
-    and the int32 histogram accumulation is order-independent).
+    and the int32 histogram accumulation is order-independent). pack_k > 0
+    packs the hist0 accumulation into the g/h lattice word (see
+    hist_pallas_q8); the emitted row channels are identical either way.
 
     Only valid when every feature fits one accumulator block
     (F * num_bins <= _ACC_ROWS_MAX)."""
     f, n = bins_T.shape
     b = num_bins
-    nch = 2 if const_hess else 3
+    nch = _q8_nch(const_hess, pack_k)
     assert f * b <= _ACC_ROWS_MAX
+    if pack_k > 0:
+        _assert_pack_budget(n, pack_k, const_hess)
     if chunk == 0:
-        chunk = 4096 if (_swar_ok(b, interpret) and f * b <= 1792) else 2048
+        chunk = 4096 if (_swar_ok(b, interpret) and f * b <= 1792
+                         and pack_k == 0) else 2048
     bins_Tp = _pad_rows(bins_T, chunk)
     score_p = _pad_rows(score, chunk)
     aux_p = _pad_rows(aux, chunk)
@@ -824,7 +949,7 @@ def grad_quant_hist0_pallas(bins_T, score, aux, bag, seed, spec,
 
     kern = functools.partial(_grad_quant_kernel, f=f, b=b, chunk=chunk,
                              spec=spec, const_hess=const_hess,
-                             swar=_swar_ok(b, interpret))
+                             swar=_swar_ok(b, interpret), pack_k=pack_k)
     gq, hq, cq, sc, out = pl.pallas_call(
         kern,
         grid=(2, n_chunks),
@@ -869,16 +994,10 @@ def grad_quant_hist0_pallas(bins_T, score, aux, bag, seed, spec,
 
     scale_g = sc[0, 0]
     scale_h = sc[1, 0]
-    out = out.reshape(f, b, nch).astype(jnp.float32)
+    out = out.reshape(f, b, nch)
     sg = scale_g * jnp.float32(1.0 / 127.0)
     sh = scale_h * jnp.float32(1.0 / 127.0)
-    if const_hess:
-        cnt = out[..., 1]
-        hist0 = jnp.stack([out[..., 0] * sg, cnt * sh, cnt],
-                          axis=-1).transpose(2, 0, 1)
-    else:
-        hist0 = jnp.stack([out[..., 0] * sg, out[..., 1] * sh, out[..., 2]],
-                          axis=-1).transpose(2, 0, 1)
+    hist0 = _dequant_stack(out, pack_k, const_hess, sg, sh).transpose(2, 0, 1)
     return (gq[:n], None if const_hess else hq[:n], cq[:n],
             scale_g, scale_h, hist0)
 

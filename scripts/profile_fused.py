@@ -31,15 +31,27 @@ def main():
     ap.add_argument("--leaves", type=int, default=255)
     ap.add_argument("--widths", type=int, nargs="*",
                     default=(1, 2, 8, 32, 64, 127))
+    ap.add_argument("--const-hess", action="store_true",
+                    help="profile the const-hessian elided kernels (the low "
+                         "channel is the 0/1 count; h reconstructed on "
+                         "dequant)")
+    ap.add_argument("--packed", action="store_true",
+                    help="pack g+low into one int32 lattice word when the "
+                         "guard-bit budget fits --rows (else reports "
+                         "packed=false and runs unpacked)")
     args = ap.parse_args()
 
     n, f, b, L = args.rows, args.features, args.max_bin, args.leaves
     interp = jax.default_backend() != "tpu"
+    pack_k = H.pack_guard_bits(n, args.const_hess) if args.packed else 0
+    nch = PH._q8_nch(args.const_hess, pack_k)
     rng = np.random.RandomState(0)
     bins_T = jnp.asarray(rng.randint(0, b, size=(f, n), dtype=np.uint8))
     gq = jnp.asarray(rng.randint(-127, 128, n, dtype=np.int8))
-    hq = jnp.asarray(rng.randint(0, 128, n, dtype=np.int8))
     cq = jnp.ones(n, jnp.int8)
+    # const-hess: the kernels read the count channel in place of hq
+    hq = cq if args.const_hess else jnp.asarray(
+        rng.randint(0, 128, n, dtype=np.int8))
     lid = jnp.asarray(rng.randint(0, L, n, dtype=np.int32))
 
     results = []
@@ -55,15 +67,23 @@ def main():
                 bt, gq, hq, cq, jnp.minimum(ll + i, L - 1), tables,
                 jnp.full(f, b + 1, jnp.int32), s, b,
                 jnp.float32(1.0), jnp.float32(1.0), L,
+                const_hess=args.const_hess, pack_k=pack_k,
                 interpret=interp)[0].sum(),
             bins_T, lid, K=4, reps=2)
-        results.append({"slot_width": s, "ms": round(ms, 3)})
+        # analytic MXU work of the level pass: the [F*B, chunk] one-hot
+        # contracts against [S*nch, chunk] row weights over all N rows
+        results.append({"slot_width": s, "ms": round(ms, 3),
+                        "channels": nch, "packed": pack_k > 0,
+                        "macs": n * f * b * s * nch})
         if not args.json:
-            print(f"fused S={s:4d}: {ms:7.2f} ms")
+            print(f"fused S={s:4d} nch={nch}{' packed' if pack_k else '':7s}:"
+                  f" {ms:7.2f} ms")
     if args.json:
         print(json.dumps({
             "rows": n, "features": f, "max_bin": b, "num_leaves": L,
             "backend": jax.default_backend(),
+            "channels": nch, "packed": pack_k > 0, "pack_guard_bits": pack_k,
+            "const_hess": args.const_hess,
             "master_slot_widths": list(PH.MASTER_SLOT_WIDTHS),
             "fused_level_pass": results}))
 

@@ -51,7 +51,8 @@ def t_loop(op, K=6, reps=3):
     return (t(fK) - t(f1)) / (K - 1)
 
 
-def shallow_megapass(bins_T, N, F, B, L, emit_json: bool):
+def shallow_megapass(bins_T, N, F, B, L, emit_json: bool,
+                     const_hess: bool = False, packed: bool = False):
     """Levels 0..D of one tree in two pallas launches.
 
     Launch 1 (grad+quant+hist0) is structural — grow_tree_depthwise's fused
@@ -59,12 +60,18 @@ def shallow_megapass(bins_T, N, F, B, L, emit_json: bool):
     histogram from (score, aux, bag) in one kernel. Here we account for it
     and measure launch 2: the D-level replay megapass vs D sequential
     single-level passes over the SAME stacked split tables, asserting
-    bit-identical histograms and final row routing."""
+    bit-identical histograms and final row routing.
+
+    ``const_hess`` profiles the hessian-elided kernels; ``packed`` requests
+    the packed g/h lattice (engages only when the guard-bit budget fits N)."""
     rng = np.random.RandomState(1)
     interp = jax.default_backend() != "tpu"
+    pack_k = H.pack_guard_bits(N, const_hess) if packed else 0
+    nch = PH._q8_nch(const_hess, pack_k)
     gq = jnp.asarray(rng.randint(-127, 128, N, dtype=np.int8))
-    hq = jnp.asarray(rng.randint(0, 128, N, dtype=np.int8))
     cq = jnp.ones(N, jnp.int8)
+    hq = cq if const_hess else jnp.asarray(
+        rng.randint(0, 128, N, dtype=np.int8))
     lid0 = jnp.zeros(N, jnp.int32)
     na_bin = jnp.full(F, B + 1, jnp.int32)
     # levels 1..D: frontier of 2^lvl leaves, every frontier leaf splits on a
@@ -92,14 +99,14 @@ def shallow_megapass(bins_T, N, F, B, L, emit_json: bool):
 
     mega = jax.jit(lambda bt, ll: PH.hist_routed_fused_multi_q8(
         bt, gq, hq, cq, ll, tuple(tables_seq), na_bin, S, B, one, one, L,
-        interpret=interp))
+        const_hess=const_hess, pack_k=pack_k, interpret=interp))
 
     def seq(bt, ll):
         hists = []
         for t in tables_seq:
             h_, ll = PH.hist_routed_fused_q8(
                 bt, gq, hq, cq, ll, t, na_bin, S, B, one, one, L,
-                interpret=interp)
+                const_hess=const_hess, pack_k=pack_k, interpret=interp)
             hists.append(h_)
         return jnp.stack(hists), ll
     seq = jax.jit(seq)
@@ -119,6 +126,12 @@ def shallow_megapass(bins_T, N, F, B, L, emit_json: bool):
     out = {
         "levels": list(range(0, D + 1)),
         "slot_width": S,
+        "channels": nch,
+        "packed": pack_k > 0,
+        "pack_guard_bits": pack_k,
+        # analytic MXU work of one level pass: [F*B, chunk] one-hot x
+        # [S*nch, chunk] row weights over all N rows
+        "macs_per_level": N * F * B * S * nch,
         "pallas_launches": 2,
         "launch_breakdown": [
             "grad_quant_hist0_pallas (gradients + int8 quantize + level-0 "
@@ -144,6 +157,11 @@ def main():
     ap.add_argument("--features", type=int, default=28)
     ap.add_argument("--leaves", type=int, default=255)
     ap.add_argument("--max-bin", type=int, default=64)
+    ap.add_argument("--const-hess", action="store_true",
+                    help="profile the const-hessian elided q8 megapass")
+    ap.add_argument("--packed", action="store_true",
+                    help="request the packed g/h lattice for the megapass "
+                         "(engages only when the guard budget fits --rows)")
     args = ap.parse_args()
 
     N, F, B, L = args.rows, args.features, args.max_bin, args.leaves
@@ -257,11 +275,14 @@ def main():
     if not args.json:
         print(f"{'grow_tree_depthwise whole':50s} {per*1000:9.2f} ms")
 
-    shallow = shallow_megapass(bins_T, N, F, B, L, args.json)
+    shallow = shallow_megapass(bins_T, N, F, B, L, args.json,
+                               const_hess=args.const_hess,
+                               packed=args.packed)
     if args.json:
         print(json.dumps({
             "rows": N, "features": F, "max_bin": B, "num_leaves": L,
             "backend": jax.default_backend(),
+            "channels": shallow["channels"], "packed": shallow["packed"],
             "phases_ms": phases, "shallow": shallow}))
 
 

@@ -1,0 +1,261 @@
+"""Packed g/h gradient lattice + const-hessian channel elision (ISSUE 20).
+
+The q8 histogram kernels can pack the int8 g lattice and the low channel
+(hq, or the 0/1 count under const-hessian elision) into ONE int32 word
+``g * 2^k + low`` and accumulate both in a single MXU contraction channel;
+the epilogue unpacks exactly (``low = P & (2^k - 1)``, ``g = P >> k``).
+The contract is BIT-identity, not tolerance: every test here runs the
+pallas kernels in interpret mode on CPU and asserts exact agreement
+packed-vs-unpacked (kernel level) and across whole models for the
+{gbdt, dart, goss, rf} x {l2, logloss} matrix, plus 2ch-vs-3ch for the
+const-hessian family. The guard-bit overflow drill proves the automatic
+fallback to the unpacked kernels is bit-identical and observable via the
+schema-registered ``hist_pack_fallback`` event."""
+import numpy as np
+import pytest
+
+import jax.numpy as jnp
+
+import lightgbm_tpu as lgb
+from lightgbm_tpu import obs
+from lightgbm_tpu.ops import histogram as hg
+from lightgbm_tpu.ops import pallas_hist as ph
+
+N, F, B, L = 220, 7, 16, 8
+SEED = 12345
+
+
+@pytest.fixture(scope="module")
+def rows():
+    rng = np.random.default_rng(0)
+    bins = jnp.asarray(rng.integers(0, B, size=(N, F)), dtype=jnp.uint8)
+    return {
+        "bins": bins, "bins_T": bins.T,
+        "score": jnp.asarray(rng.normal(size=N).astype(np.float32)),
+        "label": jnp.asarray(rng.normal(size=N).astype(np.float32)),
+        "label_pos": jnp.asarray((rng.random(N) < 0.5).astype(np.float32)),
+        "bag": jnp.asarray((rng.random(N) < 0.8).astype(np.float32)),
+        "lid": jnp.asarray(rng.integers(0, L, size=N), dtype=jnp.int32),
+        "na_bin": jnp.full((F,), -1, dtype=jnp.int32),
+    }
+
+
+def _logloss_gh(score, label_pos):
+    t = 2.0 * label_pos - 1.0
+    resp = 1.0 / (1.0 + jnp.exp(t * score))
+    return -t * resp, resp * (1.0 - resp)
+
+
+def _quant(rows, const_hess):
+    bag = rows["bag"]
+    if const_hess:
+        g, h = (rows["score"] - rows["label"]) * bag, jnp.ones(N) * bag
+    else:
+        grad, hess = _logloss_gh(rows["score"], rows["label_pos"])
+        g, h = grad * bag, hess * bag
+    c = (bag > 0).astype(jnp.float32)
+    return hg.make_quant(g, h, c, SEED, const_hess=const_hess)
+
+
+# ---------------------------------------------------------------------------
+# guard-bit budget arithmetic
+
+def test_pack_guard_bits_boundaries():
+    # smallest k with low_max * n < 2^k, checked against the int32 word bound
+    assert hg.pack_guard_bits(1, True) == 1          # 1*1 < 2
+    assert hg.pack_guard_bits(220, True) == 8        # 220 < 256
+    assert hg.pack_guard_bits(220, False) == 15      # 127*220=27940 < 2^15
+    assert hg.pack_guard_bits(4095, True) == 12      # largest const-hess fit
+    assert hg.pack_guard_bits(4096, True) == 0       # int32 bound exceeded
+    assert hg.pack_guard_bits(258, False) == 15      # largest non-const fit
+    assert hg.pack_guard_bits(259, False) == 0
+    assert hg.pack_guard_bits(0, True) == 0
+    assert hg.pack_guard_bits(-3, False) == 0
+
+
+def test_pack_budget_bounds_hold_exactly():
+    # for every accepted budget, worst-case sums provably fit
+    for const in (True, False):
+        low_max = 1 if const else 127
+        for n in (1, 7, 100, 258, 1000, 4095):
+            k = hg.pack_guard_bits(n, const)
+            if k == 0:
+                continue
+            assert low_max * n < (1 << k)
+            assert 127 * n * (1 << k) + low_max * n <= (1 << 31) - 1
+
+
+def test_effective_channel_counts():
+    assert ph._q8_nch(False, 0) == 3
+    assert ph._q8_nch(True, 0) == 2
+    assert ph._q8_nch(False, 15) == 2
+    assert ph._q8_nch(True, 8) == 1
+
+
+def test_kernel_rejects_bypassed_budget(rows):
+    q = _quant(rows, const_hess=False)
+    with pytest.raises(AssertionError, match="guard bits too small"):
+        ph.hist_pallas_q8(rows["bins_T"], q.gq, q.hq, q.cq, rows["lid"], L, B,
+                          q.scale_g, q.scale_h, pack_k=3, interpret=True)
+
+
+# ---------------------------------------------------------------------------
+# kernel-level bit-identity: packed vs unpacked
+
+@pytest.mark.parametrize("const_hess", [True, False])
+def test_hist_pallas_q8_packed_bit_exact(rows, const_hess):
+    q = _quant(rows, const_hess)
+    hq, ch = hg._q8_h_arg(q)
+    k = hg.pack_guard_bits(N, ch)
+    assert k > 0
+    ref = ph.hist_pallas_q8(rows["bins_T"], q.gq, hq, q.cq, rows["lid"], L, B,
+                            q.scale_g, q.scale_h, const_hess=ch,
+                            interpret=True)
+    got = ph.hist_pallas_q8(rows["bins_T"], q.gq, hq, q.cq, rows["lid"], L, B,
+                            q.scale_g, q.scale_h, const_hess=ch, pack_k=k,
+                            interpret=True)
+    np.testing.assert_array_equal(np.asarray(ref), np.asarray(got))
+
+
+@pytest.mark.parametrize("spec,const_hess", [
+    (("l2",), True), (("logloss", 1.0, 1.0, 1.0), False)])
+def test_fused_front_packed_bit_exact(rows, spec, const_hess):
+    aux = rows["label"] if const_hess else rows["label_pos"]
+    k = hg.pack_guard_bits(N, const_hess)
+    assert k > 0
+    ref = ph.grad_quant_hist0_pallas(
+        rows["bins_T"], rows["score"], aux, rows["bag"], SEED, spec, B,
+        const_hess=const_hess, interpret=True)
+    got = ph.grad_quant_hist0_pallas(
+        rows["bins_T"], rows["score"], aux, rows["bag"], SEED, spec, B,
+        const_hess=const_hess, pack_k=k, interpret=True)
+    for a, b in zip(ref, got):
+        if a is None or b is None:
+            assert a is None and b is None
+        else:
+            np.testing.assert_array_equal(np.asarray(a), np.asarray(b))
+
+
+@pytest.mark.parametrize("const_hess", [True, False])
+def test_megapass_packed_bit_exact(rows, const_hess):
+    """ONE D-stacked packed launch == the unpacked megapass, histograms per
+    level AND final routing."""
+    q = _quant(rows, const_hess)
+    hq, ch = hg._q8_h_arg(q)
+    k = hg.pack_guard_bits(N, ch)
+    S = 4
+
+    def mk_tables(key):
+        r = np.random.default_rng(key)
+        mk = lambda lo, hi: jnp.asarray(r.integers(lo, hi, size=L),
+                                        dtype=jnp.int32)
+        return hg.RouteTables(mk(0, F), mk(1, B - 1), mk(0, 2), mk(0, L),
+                              mk(0, S), mk(0, S))
+
+    tabs = tuple(mk_tables(i) for i in (1, 2, 3))
+    ref, lid_ref = ph.hist_routed_fused_multi_q8(
+        rows["bins_T"], q.gq, hq, q.cq, rows["lid"], tabs, rows["na_bin"],
+        S, B, q.scale_g, q.scale_h, L, const_hess=ch, interpret=True)
+    got, lid_got = ph.hist_routed_fused_multi_q8(
+        rows["bins_T"], q.gq, hq, q.cq, rows["lid"], tabs, rows["na_bin"],
+        S, B, q.scale_g, q.scale_h, L, const_hess=ch, pack_k=k,
+        interpret=True)
+    np.testing.assert_array_equal(np.asarray(lid_ref), np.asarray(lid_got))
+    np.testing.assert_array_equal(np.asarray(ref), np.asarray(got))
+
+
+# ---------------------------------------------------------------------------
+# whole-model bit-identity across the booster x objective matrix
+
+PALLAS_PARAMS = {"num_leaves": 7, "max_bin": 31, "min_data_in_leaf": 5,
+                 "verbosity": -1, "prewarm": 0, "histogram_impl": "pallas",
+                 "use_quantized_grad": "true"}
+
+BOOSTER_EXTRA = {
+    "gbdt": {},
+    "dart": {"skip_drop": 0.0, "drop_rate": 0.5},
+    "goss": {"top_rate": 0.3, "other_rate": 0.2},
+    "rf": {"bagging_freq": 1, "bagging_fraction": 0.8},
+}
+
+
+def _matrix_data():
+    rng = np.random.RandomState(0)
+    X = rng.rand(N, F).astype(np.float32)
+    yb = (X[:, 0] + 0.3 * rng.rand(N) > 0.65).astype(np.float32)
+    yr = (X[:, 1] * 2.0 + rng.rand(N)).astype(np.float32)
+    return X, {"binary": yb, "regression": yr}
+
+
+def _strip_cfg(model_str):
+    # the config echo embeds the raw hist_packed param value; the trees are
+    # what must agree
+    return "\n".join(l for l in model_str.splitlines()
+                     if not l.startswith("[hist_packed"))
+
+
+def _run(params, X, y):
+    bst = lgb.train(params, lgb.Dataset(X, label=y, params=params),
+                    num_boost_round=3)
+    return bst.predict(X, raw_score=True), _strip_cfg(bst.model_to_string())
+
+
+@pytest.mark.parametrize("boosting", ["gbdt", "dart", "goss", "rf"])
+@pytest.mark.parametrize("objective", ["regression", "binary"])
+def test_models_bit_identical_packed_vs_unpacked(monkeypatch, boosting,
+                                                 objective):
+    X, ys = _matrix_data()
+    base = dict(PALLAS_PARAMS, objective=objective,
+                boosting=boosting, **BOOSTER_EXTRA[boosting])
+    engaged = []
+    orig = hg.pack_guard_bits
+    monkeypatch.setattr(hg, "pack_guard_bits",
+                        lambda n, ch=False: engaged.append(orig(n, ch))
+                        or engaged[-1])
+    pred_p, model_p = _run(dict(base, hist_packed="auto"), X, ys[objective])
+    if boosting in ("gbdt", "dart"):
+        # auto-gradient boosters actually engage packing at this row count;
+        # goss/rf take the custom-gradient path where packing never applies
+        assert engaged and max(engaged) > 0
+    pred_u, model_u = _run(dict(base, hist_packed="false"), X, ys[objective])
+    np.testing.assert_array_equal(pred_p, pred_u)
+    assert model_p == model_u
+
+
+@pytest.mark.parametrize("boosting", ["gbdt", "dart"])
+def test_models_bit_identical_2ch_vs_3ch(monkeypatch, boosting):
+    """Const-hessian elision (2 channels) vs the flag forced off (3
+    channels): same trees, bit for bit. Only the auto-gradient boosters
+    reach the elided kernels; packing is held off so this isolates the
+    channel count."""
+    import lightgbm_tpu.objectives as O
+    X, ys = _matrix_data()
+    params = dict(PALLAS_PARAMS, objective="regression", boosting=boosting,
+                  hist_packed="false", **BOOSTER_EXTRA[boosting])
+    pred_2, model_2 = _run(params, X, ys["regression"])
+    monkeypatch.setattr(O.RegressionL2, "is_constant_hessian", False)
+    pred_3, model_3 = _run(params, X, ys["regression"])
+    np.testing.assert_array_equal(pred_2, pred_3)
+    assert model_2 == model_3
+
+
+# ---------------------------------------------------------------------------
+# guard-bit overflow drill: fallback is automatic, bit-identical, observable
+
+def test_guard_overflow_falls_back_bit_identical():
+    rng = np.random.RandomState(7)
+    n_big = 4100                      # const-hess budget tops out at 4095
+    X = rng.rand(n_big, 5).astype(np.float32)
+    y = (X[:, 0] * 2.0 + rng.rand(n_big)).astype(np.float32)
+    assert hg.pack_guard_bits(n_big, True) == 0
+    params = dict(PALLAS_PARAMS, objective="regression", telemetry=1)
+    obs.reset()
+    pred_p, model_p = _run(dict(params, hist_packed="true"), X, y)
+    evts = [e for e in obs.EVENTS.snapshot()
+            if e["type"] == "hist_pack_fallback"]
+    assert evts and evts[0]["n_rows"] == n_big
+    assert evts[0]["reason"] == "guard_budget"
+    assert evts[0]["requested"] == "true"
+    pred_u, model_u = _run(dict(params, hist_packed="false"), X, y)
+    np.testing.assert_array_equal(pred_p, pred_u)
+    assert model_p == model_u

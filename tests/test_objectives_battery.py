@@ -134,3 +134,86 @@ def test_fair_and_huber():
         pred = np.asarray(bst.predict(X))
         med_err = float(np.median(np.abs(pred - X[:, 0] * 3)))
         assert med_err < 1.0, f"{obj}: median error {med_err}"
+
+
+# ---------------------------------------------------------------------------
+# const-hessian flag audit (ISSUE 20 satellite): the is_constant_hessian bit
+# drives channel elision in the q8 histogram kernels (GrowParams.const_hess),
+# so a wrongly-True flag would silently corrupt hessian sums. Property-test
+# every scalar objective: a True flag requires the REPORTED hessians to be
+# row-constant for any score vector, and for smooth objectives the reported
+# hessian must match the numerical derivative of the reported gradient (the
+# Newton-step contract the kernels rely on).
+
+_SCALAR_OBJECTIVES = ["regression", "regression_l1", "huber", "fair",
+                      "poisson", "quantile", "mape", "gamma", "tweedie",
+                      "binary", "cross_entropy", "cross_entropy_lambda"]
+# objectives whose gradient is differentiable at generic points (central
+# difference is exact up to f32 noise); l1/quantile/mape/huber are piecewise
+_SMOOTH = {"regression", "fair", "poisson", "gamma", "tweedie", "binary",
+           "cross_entropy", "cross_entropy_lambda"}
+
+
+def _objective_fixture(name, n=64, seed=3):
+    from lightgbm_tpu.objectives import create_objective
+    rng = np.random.RandomState(seed)
+    if name in ("binary", "cross_entropy", "cross_entropy_lambda"):
+        label = (rng.rand(n) > 0.5).astype(np.float32)
+    elif name in ("poisson", "gamma", "tweedie", "mape"):
+        label = (rng.rand(n) * 4 + 0.5).astype(np.float32)
+    else:
+        label = rng.randn(n).astype(np.float32)
+    obj = create_objective(name, Config({"objective": name}))
+    obj.init(jnp.asarray(label), None, None)
+    score = jnp.asarray(rng.randn(n).astype(np.float32) * 0.5)
+    return obj, score
+
+
+@pytest.mark.parametrize("name", _SCALAR_OBJECTIVES)
+def test_const_hessian_flag_matches_reported_hessian(name):
+    obj, score = _objective_fixture(name)
+    _, h1 = obj.get_gradients(score)
+    _, h2 = obj.get_gradients(score * -1.7 + 0.3)
+    h1, h2 = np.asarray(h1), np.asarray(h2)
+    reported_const = (np.all(h1 == h1[0]) and np.all(h2 == h1[0]))
+    if getattr(obj, "is_constant_hessian", False):
+        assert reported_const, (
+            f"{name}: is_constant_hessian=True but reported hessians vary "
+            f"(range {h1.min()}..{h1.max()}) — channel elision would corrupt "
+            f"hessian sums")
+    # the converse (constant hessians but a False flag) is allowed: the flag
+    # is a conservative optimization bit, e.g. Huber keeps it off
+
+
+@pytest.mark.parametrize("name", sorted(_SMOOTH))
+def test_reported_hessian_matches_numerical(name):
+    obj, score = _objective_fixture(name)
+    g0, h0 = obj.get_gradients(score)
+    eps = 1e-3
+    gp, _ = obj.get_gradients(score + eps)
+    gm, _ = obj.get_gradients(score - eps)
+    h_num = (np.asarray(gp, np.float64) - np.asarray(gm, np.float64)) / (2 * eps)
+    h0 = np.asarray(h0, np.float64)
+    if name == "poisson":
+        # the reference deliberately inflates the poisson hessian by
+        # exp(max_delta_step) as a step-size safeguard
+        # (regression_objective.hpp PoissonLoss); divide it back out so the
+        # property still pins the hessian SHAPE to d(grad)/d(score)
+        h0 = h0 / obj._hess_scale
+    np.testing.assert_allclose(h_num, h0, rtol=5e-2, atol=5e-3,
+                               err_msg=f"{name}: reported hessian disagrees "
+                                       f"with d(grad)/d(score)")
+
+
+def test_const_hessian_flag_clears_with_weights():
+    """Row weights make even the L2 family's hessians vary per row — init
+    must drop the flag (the kernels would otherwise elide a channel that
+    now carries information)."""
+    from lightgbm_tpu.objectives import create_objective
+    rng = np.random.RandomState(0)
+    label = jnp.asarray(rng.randn(32).astype(np.float32))
+    w = jnp.asarray((rng.rand(32) + 0.5).astype(np.float32))
+    for name in ("regression", "regression_l1", "quantile"):
+        obj = create_objective(name, Config({"objective": name}))
+        obj.init(label, w, None)
+        assert not obj.is_constant_hessian, f"{name} with weights"

@@ -31,6 +31,24 @@ def test_profile_fused_json():
     widths = [e["slot_width"] for e in doc["fused_level_pass"]]
     assert widths == [1, 8]
     assert all(e["ms"] > 0 for e in doc["fused_level_pass"])
+    # channel accounting: plain q8 accumulates 3 channels, and the analytic
+    # MAC count scales with them (N * F * B * S * nch)
+    assert doc["channels"] == 3 and doc["packed"] is False
+    e = doc["fused_level_pass"][1]
+    assert e["channels"] == 3 and e["macs"] == 512 * 28 * 64 * 8 * 3
+
+
+@pytest.mark.slow
+def test_profile_fused_json_packed_const_hess():
+    """--const-hess --packed at 512 rows fits the guard budget (k=10) and
+    drops the level pass to ONE accumulated channel."""
+    doc = _run_json("profile_fused.py", "--rows", "512", "--widths", "8",
+                    "--const-hess", "--packed")
+    assert doc["channels"] == 1 and doc["packed"] is True
+    assert doc["pack_guard_bits"] == 10
+    e = doc["fused_level_pass"][0]
+    assert e["channels"] == 1 and e["packed"] is True
+    assert e["macs"] == 512 * 28 * 64 * 8 * 1
 
 
 @pytest.mark.slow
@@ -47,3 +65,20 @@ def test_profile_level_json_shallow_two_launches():
     assert len(shallow["launch_breakdown"]) == 2
     assert shallow["bit_identical_vs_sequential"] is True
     assert shallow["levels"] == [0, 1, 2, 3, 4, 5]
+    assert doc["channels"] == 3 and doc["packed"] is False
+    assert shallow["macs_per_level"] == 512 * 4 * 16 * shallow["slot_width"] * 3
+
+
+@pytest.mark.slow
+def test_profile_level_json_packed_reduces_channels():
+    """The acceptance headline: profile_level --json reports the REDUCED
+    channel count when const-hess elision + packing are active, and the
+    packed megapass stays bit-identical to the sequential passes."""
+    doc = _run_json("profile_level.py", "--rows", "512", "--leaves", "31",
+                    "--features", "4", "--max-bin", "16",
+                    "--const-hess", "--packed")
+    shallow = doc["shallow"]
+    assert doc["channels"] == 1 and doc["packed"] is True
+    assert shallow["pack_guard_bits"] == 10
+    assert shallow["bit_identical_vs_sequential"] is True
+    assert shallow["macs_per_level"] == 512 * 4 * 16 * shallow["slot_width"] * 1

@@ -107,6 +107,42 @@ def f(x, opts=[1, 2]):
     return x
 """
 
+RETRACE_ARGNUMS_OOR = """
+import jax
+from functools import partial
+
+@partial(jax.jit, static_argnums=(5,))
+def f(x, pack_k):
+    return x * pack_k
+"""
+
+RETRACE_ARGNUMS_OOR_SUPPRESSED = """
+import jax
+from functools import partial
+
+@partial(jax.jit, static_argnums=(5,))   # tpu-lint: disable=retrace-hazard
+def f(x, pack_k):
+    return x * pack_k
+"""
+
+RETRACE_ARGNUMS_CLEAN = """
+import jax
+from functools import partial
+
+@partial(jax.jit, static_argnums=(1,))
+def f(x, pack_k=0):
+    return x * pack_k
+"""
+
+RETRACE_ARGNUMS_UNHASHABLE = """
+import jax
+from functools import partial
+
+@partial(jax.jit, static_argnums=(1,))
+def f(x, widths=[32, 128]):
+    return x
+"""
+
 RETRACE_TRACED_BRANCH = """
 import jax
 import jax.numpy as jnp
@@ -151,6 +187,35 @@ def test_retrace_fires_on_unhashable_static_default():
 
 def test_retrace_fires_on_traced_branch():
     assert "retrace-hazard" in names(analyze_source(RETRACE_TRACED_BRANCH))
+
+
+def test_retrace_fires_on_out_of_range_static_argnums():
+    """static_argnums past the positional parameter list: the arg the
+    index was meant to pin (a pack_k-style compile-time constant) stays
+    traced, so every distinct value becomes an executable variant."""
+    fs = analyze_source(RETRACE_ARGNUMS_OOR)
+    assert any(f.rule == "retrace-hazard" and "out of range" in f.message
+               for f in fs)
+
+
+def test_retrace_argnums_in_range_clean():
+    assert "retrace-hazard" not in names(analyze_source(RETRACE_ARGNUMS_CLEAN))
+
+
+def test_retrace_argnums_oor_suppressed():
+    assert "retrace-hazard" not in names(
+        analyze_source(RETRACE_ARGNUMS_OOR_SUPPRESSED))
+    kept = analyze_source(RETRACE_ARGNUMS_OOR_SUPPRESSED,
+                          keep_suppressed=True)
+    assert "retrace-hazard" in names(kept)
+
+
+def test_retrace_fires_on_unhashable_default_at_argnums_position():
+    """the static_argnums->name mapping feeds the unhashable-default check
+    too (not just static_argnames)"""
+    fs = analyze_source(RETRACE_ARGNUMS_UNHASHABLE)
+    assert any(f.rule == "retrace-hazard" and "unhashable" in f.message
+               for f in fs)
 
 
 def test_retrace_clean_on_module_level_and_shape_branch():
@@ -201,8 +266,53 @@ def f(x):
 """
 
 
+DTYPE_I64_BAD = """
+import jax.numpy as jnp
+
+def upload_words(words):
+    # packed lattice words occupy bits up to 30: the silent narrow to
+    # int32 under disabled x64 is exactly the hazard
+    return jnp.asarray(words, dtype=jnp.int64)
+"""
+
+DTYPE_I64_CLEAN = """
+import numpy as np
+import jax.numpy as jnp
+
+def f(words, n):
+    # host-side numpy keeps its 64 bits: not a device request
+    hi = np.asarray(words, dtype=np.int64)
+    # transient wide int, immediately narrowed with an explicit dtype
+    low = jnp.arange(n, dtype=jnp.int64).astype(jnp.int32)
+    return jnp.asarray(hi >> 15, dtype=jnp.int32) + low
+"""
+
+DTYPE_I64_SUPPRESSED = """
+import jax.numpy as jnp
+
+def f(words):
+    # words proven < 2**31 upstream by the guard-bit budget assert
+    return jnp.asarray(words, dtype=jnp.int64)  # tpu-lint: disable=dtype-drift
+"""
+
+
 def test_dtype_drift_fires():
     assert "dtype-drift" in names(analyze_source(DTYPE_BAD))
+
+
+def test_dtype_drift_fires_on_jnp_int64_request():
+    fs = analyze_source(DTYPE_I64_BAD)
+    assert any(f.rule == "dtype-drift" and "int64" in f.message for f in fs)
+
+
+def test_dtype_drift_int64_clean_on_host_numpy_and_narrowed():
+    assert "dtype-drift" not in names(analyze_source(DTYPE_I64_CLEAN))
+
+
+def test_dtype_drift_int64_suppressed():
+    assert "dtype-drift" not in names(analyze_source(DTYPE_I64_SUPPRESSED))
+    kept = analyze_source(DTYPE_I64_SUPPRESSED, keep_suppressed=True)
+    assert "dtype-drift" in names(kept)
 
 
 def test_dtype_drift_flags_implicit_default():
@@ -1551,8 +1661,10 @@ RULE_FIXTURES = {
     "host-sync-in-jit": [("HOST_SYNC_BAD", None),
                          ("INGEST_HOT_LOOP_BAD", "lightgbm_tpu/ingest.py"),
                          ("FLEET_PROBE_FIRE", FLEET_REPLICA_REL)],
-    "retrace-hazard": [("RETRACE_JIT_IN_FN", None)],
-    "dtype-drift": [("DTYPE_BAD", None)],
+    "retrace-hazard": [("RETRACE_JIT_IN_FN", None),
+                       ("RETRACE_ARGNUMS_OOR", None)],
+    "dtype-drift": [("DTYPE_BAD", None),
+                    ("DTYPE_I64_BAD", None)],
     "unlocked-shared-state": [("SHARED_BAD", "lightgbm_tpu/serving.py"),
                               ("FLEET_SHARED_FIRE", FLEET_ROLLOUT_REL)],
     "unsharded-transfer": [("UNSHARDED_BAD", "lightgbm_tpu/ingest.py")],
